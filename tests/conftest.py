@@ -1,0 +1,33 @@
+"""Test env: 8 virtual CPU devices so mesh/sharding/collective behavior gets
+real multi-device coverage without a TPU (SURVEY.md §4)."""
+
+import os
+
+# Must happen before the first backend initialization. Note the TPU tunnel in
+# this image force-registers an 'axon' platform via sitecustomize, so the env
+# var alone is not enough — jax.config is overridden below too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def imagefolder(tmp_path_factory):
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    root = tmp_path_factory.mktemp("data")
+    return str(make_synthetic_imagefolder(str(root), classes=("a", "b", "c"),
+                                          per_class=6, size=32))

@@ -1,0 +1,81 @@
+"""Checkpoint manager: best/latest tracks, lenient restore, resume."""
+
+import jax
+import numpy as np
+
+from tpuic.checkpoint.manager import CheckpointManager, lenient_restore
+from tpuic.config import ModelConfig, OptimConfig
+from tpuic.models import create_model
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+
+OCFG = OptimConfig(optimizer="adam", learning_rate=1e-3, class_weights=(),
+                   milestones=())
+
+
+def _state(num_classes=3):
+    model = create_model("resnet18-cifar", num_classes, dtype="float32")
+    tx = make_optimizer(OCFG)
+    return create_train_state(model, tx, jax.random.key(0), (2, 32, 32, 3))
+
+
+def test_lenient_restore_key_intersection():
+    # Reference train.py:143-148: copy only keys present in both.
+    current = {"a": np.zeros((2,)), "b": {"c": np.zeros((3,))},
+               "only_new": np.zeros((4,))}
+    saved = {"a": np.ones((2,)), "b": {"c": np.ones((3,))},
+             "only_old": np.ones((5,))}
+    merged, n_loaded, n_total = lenient_restore(current, saved)
+    assert n_loaded == 2 and n_total == 3
+    np.testing.assert_array_equal(merged["a"], 1.0)
+    np.testing.assert_array_equal(merged["b"]["c"], 1.0)
+    np.testing.assert_array_equal(merged["only_new"], 0.0)
+
+
+def test_lenient_restore_shape_mismatch_skipped():
+    current = {"w": np.zeros((2, 2))}
+    saved = {"w": np.ones((3, 3))}
+    merged, n_loaded, _ = lenient_restore(current, saved)
+    assert n_loaded == 0
+    np.testing.assert_array_equal(merged["w"], 0.0)
+
+
+def test_save_best_and_restore_roundtrip(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "resnet18-cifar", save_period=5)
+    mgr.save_best(state, epoch=3, best_score=88.5)
+
+    state2 = _state()
+    restored, start_epoch, best = mgr.restore_into(state2, "best")
+    assert start_epoch == 4  # true resume (reference bug fixed: train.py:161)
+    assert best == 88.5
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_restore_missing_is_noop(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "nothing-here")
+    restored, start_epoch, best = mgr.restore_into(state)
+    assert start_epoch == 0 and best == 0.0
+
+
+def test_latest_period_gating(tmp_path):
+    import os
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "m", save_period=5)
+    mgr.maybe_save_latest(state, epoch=2, best_score=0.0)  # (2+1)%5 != 0
+    assert not os.path.isdir(os.path.join(mgr.root, "latest"))
+    mgr.maybe_save_latest(state, epoch=4, best_score=0.0)  # (4+1)%5 == 0
+    assert os.path.isdir(os.path.join(mgr.root, "latest"))
+
+
+def test_lenient_restore_across_architectures(tmp_path):
+    # Save a 3-class head, restore into a 4-class head: backbone transfers,
+    # head output layer stays fresh (shape mismatch skipped).
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_best(_state(num_classes=3), epoch=0, best_score=1.0)
+    state4 = _state(num_classes=4)
+    restored, _, _ = mgr.restore_into(state4, "best")
+    assert np.asarray(restored.params["head"]["out"]["kernel"]).shape == (32, 4)

@@ -1,0 +1,30 @@
+"""AverageMeter / accuracy semantics (reference utils.py:5-27)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpuic.metrics import AverageMeter, accuracy
+
+
+def test_average_meter_running_semantics():
+    m = AverageMeter()
+    m.update(2.0)        # val=2 sum=2 count=1
+    m.update(4.0, n=3)   # sum=14 count=4
+    assert m.val == 4.0
+    assert m.sum == 14.0
+    assert m.count == 4
+    assert m.avg == 3.5
+
+
+def test_average_meter_reset():
+    m = AverageMeter()
+    m.update(5.0)
+    m.reset()
+    assert (m.val, m.sum, m.count, m.avg) == (0.0, 0.0, 0, 0.0)
+
+
+def test_accuracy_matches_argmax_eq():
+    logits = jnp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = jnp.array([1, 1, 1])
+    acc = accuracy(logits, labels)
+    np.testing.assert_array_equal(np.asarray(acc), [1.0, 0.0, 1.0])

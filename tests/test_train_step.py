@@ -1,0 +1,120 @@
+"""Compiled train/eval step: single device and 8-device DP mesh.
+
+The 8-device cases are the CI stand-in for pod runs (SURVEY.md §4): gradient
+averaging, global-batch BN statistics (SyncBN semantics), and exact global
+eval accuracy all exercise real multi-device sharding.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.config import MeshConfig, ModelConfig, OptimConfig
+from tpuic.data.synthetic import synthetic_batch
+from tpuic.models import create_model
+from tpuic.runtime.mesh import make_mesh
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_eval_step, make_train_step
+
+MCFG = ModelConfig(name="resnet18-cifar", num_classes=3, dtype="float32")
+OCFG = OptimConfig(optimizer="adam", learning_rate=1e-3, class_weights=(),
+                   milestones=())
+
+
+def _state(mcfg=MCFG, ocfg=OCFG, batch=8, size=32):
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype)
+    tx = make_optimizer(ocfg)
+    return create_train_state(model, tx, jax.random.key(0),
+                              (batch, size, size, 3))
+
+
+def test_train_step_single_device_updates_params():
+    state = _state()
+    step = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+    batch = {k: jnp.asarray(v) for k, v in
+             synthetic_batch(8, 32, 3).items()}
+    new_state, metrics = step(state, batch)
+    assert float(metrics["loss"]) > 0
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+    assert int(new_state.step) == 1
+    before = jax.tree_util.tree_leaves(state.params)
+    after = jax.tree_util.tree_leaves(new_state.params)
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+
+def test_train_step_loss_decreases():
+    state = _state()
+    step = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(8, 32, 3).items()}
+    first = None
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+def test_mesh_step_matches_single_device(devices8):
+    """DP over 8 devices must be numerically the same program as 1 device."""
+    mesh = make_mesh(MeshConfig(), devices8)
+    batch_np = synthetic_batch(16, 32, 3, seed=7)
+
+    state1 = _state(batch=16)
+    step1 = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+    _, m1 = step1(state1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+
+    state8 = _state(batch=16)
+    step8 = make_train_step(OCFG, MCFG, mesh=mesh, donate=False)
+    _, m8 = step8(state8, batch_np)
+
+    assert abs(float(m1["loss"]) - float(m8["loss"])) < 1e-4
+    assert abs(float(m1["accuracy"]) - float(m8["accuracy"])) < 1e-6
+
+
+def test_bn_stats_are_global_batch_stats(devices8):
+    """SyncBN parity (reference train.py:124): BN batch statistics under the
+    sharded step must equal the UNSHARDED global-batch statistics, not
+    per-shard statistics."""
+    mesh = make_mesh(MeshConfig(), devices8)
+    # Make per-device shards wildly different so local != global stats.
+    batch_np = synthetic_batch(16, 32, 3, seed=1)
+    scale = np.repeat(np.arange(1, 9, dtype=np.float32), 2)
+    batch_np["image"] = (batch_np["image"]
+                         * scale[:, None, None, None]).astype(np.float32)
+
+    state1 = _state(batch=16)
+    step1 = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+    s1, _ = step1(state1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+
+    state8 = _state(batch=16)
+    step8 = make_train_step(OCFG, MCFG, mesh=mesh, donate=False)
+    s8, _ = step8(state8, batch_np)
+
+    stats1 = jax.tree_util.tree_leaves(jax.device_get(s1.batch_stats))
+    stats8 = jax.tree_util.tree_leaves(jax.device_get(s8.batch_stats))
+    for a, b in zip(stats1, stats8):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+
+def test_eval_step_exact_counts(devices8):
+    mesh = make_mesh(MeshConfig(), devices8)
+    state = _state()
+    estep = make_eval_step(OCFG, MCFG, mesh=mesh)
+    batch = synthetic_batch(16, 32, 3)
+    batch["mask"] = np.array([1.0] * 10 + [0.0] * 6, np.float32)
+    m = estep(state, batch)
+    assert float(m["count"]) == 10.0
+    assert 0.0 <= float(m["correct"]) <= 10.0
+
+
+def test_weighted_ce_in_step_with_class_weights():
+    ocfg = dataclasses.replace(OCFG, class_weights=(3.0, 1.0, 5.0))
+    state = _state(ocfg=ocfg)
+    step = make_train_step(ocfg, MCFG, mesh=None, donate=False)
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(8, 32, 3).items()}
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,67 @@
+"""Transform semantics: normalize constants, resize, augment branches
+(reference dp/loader.py:39-91)."""
+
+import numpy as np
+
+from tpuic.data import transforms as T
+
+
+def test_normalize_golden_values():
+    # /255 then (x-mean)/std with ImageNet stats (dp/loader.py:86-91).
+    img = np.full((2, 2, 3), 255, np.uint8)
+    out = T.normalize(img)
+    expect = (1.0 - np.array([0.485, 0.456, 0.406])) / np.array(
+        [0.229, 0.224, 0.225])
+    np.testing.assert_allclose(out[0, 0], expect, rtol=1e-6)
+    zero = T.normalize(np.zeros((1, 1, 3), np.uint8))
+    expect0 = -np.array([0.485, 0.456, 0.406]) / np.array([0.229, 0.224, 0.225])
+    np.testing.assert_allclose(zero[0, 0], expect0, rtol=1e-6)
+
+
+def test_resize_nearest_matches_cv2_if_available():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (37, 53, 3), np.uint8)
+    ours = T.resize_nearest(img, 16)
+    assert ours.shape == (16, 16, 3)
+    try:
+        import cv2
+    except ImportError:
+        return
+    theirs = cv2.resize(img, (16, 16), interpolation=cv2.INTER_NEAREST)
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_resize_identity():
+    img = np.arange(4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 3)
+    np.testing.assert_array_equal(T.resize_nearest(img, 4), img)
+
+
+def test_to_rgb_grayscale_and_alpha():
+    gray = np.zeros((3, 3), np.uint8)
+    assert T.to_rgb(gray).shape == (3, 3, 3)
+    rgba = np.zeros((3, 3, 4), np.uint8)
+    assert T.to_rgb(rgba).shape == (3, 3, 3)
+
+
+def test_augment_deterministic_given_seed():
+    img = np.random.default_rng(1).integers(0, 255, (8, 8, 3), np.uint8)
+    a = T.augment(img.copy(), np.random.default_rng(42))
+    b = T.augment(img.copy(), np.random.default_rng(42))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_augment_color_chain_is_exclusive():
+    # The if/elif chain (dp/loader.py:74-81) applies at most one color op;
+    # with all probabilities 0, output is a pure geometric transform of input.
+    img = np.random.default_rng(2).integers(0, 255, (6, 6, 3), np.uint8)
+    out = T.augment(img, np.random.default_rng(0), p_saturation=0.0,
+                    p_brightness=0.0, p_contrast=0.0)
+    assert sorted(out.flatten().tolist()) == sorted(img.flatten().tolist())
+
+
+def test_brightness_contrast_saturation_math():
+    img = np.full((2, 2, 3), 100, np.float32)
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.1), 110.0)
+    # Uniform image: contrast/saturation blends are no-ops.
+    np.testing.assert_allclose(T.adjust_contrast(img, 0.9), 100.0, rtol=1e-5)
+    np.testing.assert_allclose(T.adjust_saturation(img, 0.9), 100.0, rtol=1e-4)

@@ -1,0 +1,31 @@
+"""tpuic — TPU-native distributed image-classification training framework.
+
+A ground-up JAX / XLA / pjit re-design of the capabilities of
+``RanjanBalappa/pytorch-imageclassification-distributed`` (a PyTorch
+DistributedDataParallel trainer over NCCL; see SURVEY.md for the full
+structural analysis):
+
+- ``tpuic.config``      — every constant the reference hard-codes, as dataclass fields
+- ``tpuic.runtime``     — multi-host init + device-mesh construction (replaces
+                          ``dist.init_process_group('nccl')``, reference train.py:102)
+- ``tpuic.parallel``    — mesh/sharding helpers and collective utilities (replaces
+                          reference ddp_utils.py)
+- ``tpuic.data``        — ImageFolder pipeline: decode/resize/augment/normalize with
+                          seeded RNG and per-host sharding (replaces reference
+                          dp/loader.py + DistributedSampler)
+- ``tpuic.models``      — Flax backbones (see ``tpuic.models.available_models()``)
+                          + the MLP classifier head (replaces reference
+                          nn/classifier.py)
+- ``tpuic.train``       — compiled train/eval steps with cross-replica gradient and
+                          BatchNorm reductions (replaces reference train.py:36-97 and
+                          DDP/SyncBN, train.py:124,128)
+- ``tpuic.checkpoint``  — best/latest checkpointing with lenient partial restore
+                          (replaces reference train.py:131-188)
+- ``tpuic.metrics``     — AverageMeter / accuracy / host-0 logging (replaces reference
+                          utils.py)
+- ``tpuic.ops``         — Pallas TPU kernels for fused hot ops
+"""
+
+__version__ = "0.1.0"
+
+from tpuic.config import Config  # noqa: F401

@@ -1,0 +1,1 @@
+from tpuic.checkpoint.manager import CheckpointManager, lenient_restore  # noqa: F401

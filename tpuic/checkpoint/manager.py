@@ -1,0 +1,158 @@
+"""Checkpointing: best/latest tracks, lenient restore, true resume.
+
+Capability parity with reference train.py:131-188, redesigned for multi-host
+TPU via Orbax (every host participates in saving its shards; the reference is
+rank-0 ``torch.save`` of a replicated state_dict):
+
+- **Tracks**: ``{ckpt_dir}/{name}/best`` saved whenever val accuracy improves
+  (train.py:173-180) and ``{ckpt_dir}/{name}/latest`` every ``save_period``
+  epochs (train.py:183-188, period 5).
+- **Payload**: params, batch_stats, opt_state, epoch, best_score — the
+  reference saves {'epoch','best_score','state_dict'} (train.py:177-179) and
+  silently loses optimizer state across restarts; here it round-trips.
+- **Lenient restore** (``lenient_restore``): key-intersection copy exactly like
+  train.py:143-148 — only leaves present in BOTH trees with matching shapes
+  are taken from the checkpoint — so architecture drift degrades gracefully.
+- **True resume**: the reference restores ``start_epoch`` but restarts its loop
+  at 0 anyway (train.py:149-150 vs 161 — latent bug); here the trainer resumes
+  at the saved epoch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tpuic.metrics.logging import host0_print
+
+
+def _flatten(tree, prefix=()) -> Dict[Tuple, Any]:
+    out = {}
+    if hasattr(tree, "items"):  # dict and flax FrozenDict
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (k,)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat: Dict[Tuple, Any]):
+    root: Dict = {}
+    for path, v in flat.items():
+        d = root
+        for k in path[:-1]:
+            d = d.setdefault(k, {})
+        d[path[-1]] = v
+    return root
+
+
+def lenient_restore(current: Dict, restored: Dict) -> Tuple[Dict, int, int]:
+    """Key-intersection merge (reference train.py:143-148).
+
+    Returns (merged tree, n_loaded, n_total_current). A leaf is taken from
+    ``restored`` iff its path exists in both trees and shapes match.
+    """
+    cur = _flatten(current)
+    res = _flatten(restored)
+    loaded = 0
+    merged = {}
+    for path, leaf in cur.items():
+        r = res.get(path)
+        if r is not None and getattr(r, "shape", None) == getattr(leaf, "shape", None):
+            merged[path] = np.asarray(r).astype(leaf.dtype) if hasattr(leaf, "dtype") else r
+            loaded += 1
+        else:
+            merged[path] = leaf
+    return _unflatten(merged), loaded, len(cur)
+
+
+class CheckpointManager:
+    """best/latest checkpoint tracks under ``{ckpt_dir}/{name}``."""
+
+    def __init__(self, ckpt_dir: str, name: str, save_period: int = 5) -> None:
+        self.root = os.path.abspath(os.path.join(ckpt_dir, name))
+        self.save_period = save_period
+        self._ckptr = ocp.PyTreeCheckpointer()
+        if jax.process_index() == 0:
+            os.makedirs(self.root, exist_ok=True)
+
+    # -- save ---------------------------------------------------------------
+    def _payload(self, state, epoch: int, best_score: float):
+        return {
+            "params": jax.tree.map(np.asarray, jax.device_get(state.params)),
+            "batch_stats": jax.tree.map(np.asarray,
+                                        jax.device_get(state.batch_stats)),
+            "opt_state": jax.tree.map(
+                np.asarray, jax.device_get(
+                    jax.tree.map(lambda x: x,
+                                 state.opt_state))),
+            "meta": {"epoch": np.int64(epoch),
+                     "best_score": np.float64(best_score),
+                     "step": np.asarray(jax.device_get(state.step))},
+        }
+
+    def _save(self, track: str, state, epoch: int, best_score: float) -> None:
+        path = os.path.join(self.root, track)
+        self._ckptr.save(path, self._payload(state, epoch, best_score),
+                         force=True)
+
+    def save_best(self, state, epoch: int, best_score: float) -> None:
+        """Reference train.py:173-180 — on val-accuracy improvement."""
+        self._save("best", state, epoch, best_score)
+        host0_print(f"[ckpt] best -> {self.root}/best "
+                    f"(epoch {epoch}, score {best_score:.4f})")
+
+    def maybe_save_latest(self, state, epoch: int, best_score: float) -> None:
+        """Reference train.py:183-188 — every ``save_period`` epochs."""
+        if (epoch + 1) % self.save_period == 0:
+            self._save("latest", state, epoch, best_score)
+            host0_print(f"[ckpt] latest -> {self.root}/latest (epoch {epoch})")
+
+    # -- restore ------------------------------------------------------------
+    def restore_into(self, state, track: str = "best"):
+        """Lenient restore of ``state`` (reference train.py:132-153).
+
+        Returns (state, start_epoch, best_score); (state, 0, 0.0) when no
+        checkpoint exists — mirroring the reference's probe at train.py:136.
+        Optimizer state is restored only on a FULL param match (a partial /
+        cross-architecture load makes saved moments meaningless).
+        """
+        path = os.path.join(self.root, track)
+        if not os.path.isdir(path):
+            return state, 0, 0.0
+        # Restoring against a structure template keeps optax's opt_state
+        # pytree types (NamedTuples) instead of raw nested lists. A
+        # cross-architecture checkpoint won't fit the template (shape
+        # mismatches) — fall back to a raw restore; lenient_restore then
+        # salvages the intersecting params and the opt_state is reset.
+        template = self._payload(state, 0, 0.0)
+        try:
+            restored = self._ckptr.restore(path, item=template)
+        except Exception:
+            restored = self._ckptr.restore(path)
+        cur_params = jax.tree.map(np.asarray, jax.device_get(state.params))
+        merged_params, n_loaded, n_total = lenient_restore(
+            cur_params, restored.get("params", {}))
+        cur_stats = jax.tree.map(np.asarray, jax.device_get(state.batch_stats))
+        merged_stats, _, _ = lenient_restore(cur_stats,
+                                             restored.get("batch_stats", {}))
+        state = state.replace(params=merged_params, batch_stats=merged_stats)
+        meta = restored.get("meta", {})
+        epoch = int(meta.get("epoch", 0))
+        best = float(meta.get("best_score", 0.0))
+        if n_loaded == n_total:
+            step = meta.get("step")
+            if step is not None:
+                state = state.replace(step=np.asarray(step))
+            try:
+                state = state.replace(opt_state=restored["opt_state"])
+            except (KeyError, TypeError):
+                host0_print("[ckpt] opt_state structure mismatch — optimizer "
+                            "state reset")
+        host0_print(f"[ckpt] restored {n_loaded}/{n_total} param leaves from "
+                    f"{path} (epoch {epoch}, best {best:.4f})")
+        return state, epoch + 1 if n_loaded else 0, best

@@ -1,0 +1,159 @@
+"""Configuration dataclasses.
+
+The reference exposes 3 argparse flags (``--local_rank``, ``--datadir``,
+``--batchsize``; train.py:27-31) and hard-codes everything else as inline
+constants. Every one of those constants is surfaced here as a named field with
+the reference's exact default (source lines cited per field), so behavior
+parity is a config choice rather than an archaeology project.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Input-pipeline settings (reference dp/loader.py + train.py:110-118)."""
+
+    data_dir: str = ""
+    # Image side length; reference hard-codes 299 (train.py:110).
+    resize_size: int = 299
+    # Per-device train batch size; reference default 4 per process (train.py:30).
+    batch_size: int = 4
+    # Reference uses val batch_size=1 (train.py:118). We default to the train
+    # batch size because SPMD eval is exact regardless of batching (the
+    # reference needed bs=1 only for its per-sample pickle all_gather), but the
+    # knob exists for strict parity runs.
+    val_batch_size: int = 0  # 0 => same as batch_size
+    # Host-side prefetch depth and worker threads (reference: num_workers=6,
+    # pin_memory=True, train.py:114).
+    num_workers: int = 6
+    prefetch: int = 2
+    # ImageNet normalization stats (reference dp/loader.py:86-91).
+    mean: Sequence[float] = (0.485, 0.456, 0.406)
+    std: Sequence[float] = (0.229, 0.224, 0.225)
+    # Global shuffle seed. The reference shuffles the file list per-rank,
+    # unseeded (dp/loader.py:23) — a correctness bug (ranks see inconsistent
+    # shards). We seed identically on every host and fold in the epoch.
+    shuffle_seed: int = 0
+    # Augmentation probabilities (reference dp/loader.py:63-83).
+    p_vflip: float = 0.5
+    p_hflip: float = 0.5
+    p_saturation: float = 0.05
+    p_brightness: float = 0.05
+    p_contrast: float = 0.05
+    jitter_lo: float = 0.9
+    jitter_hi: float = 1.1
+
+    def resolved_val_batch_size(self) -> int:
+        return self.val_batch_size or self.batch_size
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model settings (reference nn/classifier.py + train.py:122-123)."""
+
+    # Backbone name; the reference hard-codes 'inceptionv3' (train.py:122) —
+    # that becomes the default once the Inception backbone lands in the
+    # registry; until then the flagship ResNet-50 is the default.
+    name: str = "resnet50"
+    num_classes: int = 7
+    # MLP head widths (reference nn/classifier.py:26-34: in->128->64->32->n).
+    head_widths: Sequence[int] = (128, 64, 32)
+    # Compute dtype. bfloat16 feeds the MXU at full rate; params stay f32.
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # BatchNorm momentum/eps matching torch defaults the reference inherits.
+    bn_momentum: float = 0.9  # flax convention: ema = m*ema + (1-m)*batch
+    bn_eps: float = 1e-5
+    # Inception aux-logits loss weight (reference train.py:52).
+    aux_loss_weight: float = 0.4
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer + schedule (reference train.py:127, 156-158)."""
+
+    optimizer: str = "adam"  # 'adam' | 'lars' | 'sgd'
+    # Reference lr=0.5e-5 (train.py:127).
+    learning_rate: float = 0.5e-5
+    # MultiStepLR milestones=[50, 80], gamma=0.5 (train.py:156).
+    milestones: Sequence[int] = (50, 80)
+    gamma: float = 0.5
+    # Class weights for CrossEntropy; reference hard-codes a 7-class imbalance
+    # vector (train.py:157-158). Empty => unweighted.
+    class_weights: Sequence[float] = (3.0, 3.0, 10.0, 1.0, 4.0, 4.0, 5.0)
+    weight_decay: float = 0.0
+    # LARS settings for the large-batch config (BASELINE.md config 5).
+    lars_momentum: float = 0.9
+    lars_trust_coefficient: float = 0.001
+    warmup_epochs: int = 0
+    grad_clip_norm: float = 0.0
+    label_smoothing: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Training-loop + checkpoint settings (reference train.py:131-188)."""
+
+    epochs: int = 100  # reference range(100), train.py:161
+    ckpt_dir: str = "dtmodel/cp"  # reference train.py:136
+    save_period: int = 5  # 'latest' every 5 epochs, train.py:183
+    resume: bool = True
+    log_every_steps: int = 1
+    # Profiler trace dir ('' disables). The reference has no profiling at all
+    # (SURVEY.md §5); jax.profiler makes it nearly free so it is first-class.
+    profile_dir: str = ""
+    profile_steps: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh axes.
+
+    The reference's only strategy is data parallelism (train.py:128). We build
+    the mesh with both a ``data`` and a ``model`` axis so tensor-parallel
+    sharding can be added without a rewrite (SURVEY.md §2c). model=1 means
+    pure DP — and until param partitioning is wired into the train step,
+    model>1 only narrows the data axis; leave it at 1.
+    data=0 => inferred from device count.
+    """
+
+    data: int = 0  # 0 => all devices / model
+    model: int = 1
+    axis_names: Sequence[str] = ("data", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    run: RunConfig = dataclasses.field(default_factory=RunConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def cifar10_config(data_dir: str = "") -> Config:
+    """BASELINE.md parity config 1: ResNet-18 / CIFAR-10, single process."""
+    return Config(
+        data=DataConfig(data_dir=data_dir, resize_size=32, batch_size=128),
+        model=ModelConfig(name="resnet18", num_classes=10),
+        optim=OptimConfig(optimizer="adam", learning_rate=1e-3, class_weights=()),
+    )
+
+
+def imagenet_resnet50_config(data_dir: str = "") -> Config:
+    """BASELINE.md parity config 2: ResNet-50 / ImageNet, data parallel."""
+    return Config(
+        data=DataConfig(data_dir=data_dir, resize_size=224, batch_size=256),
+        model=ModelConfig(name="resnet50", num_classes=1000),
+        optim=OptimConfig(optimizer="lars", learning_rate=4.8, class_weights=(),
+                          weight_decay=1e-4, warmup_epochs=5),
+        run=RunConfig(epochs=90),
+    )

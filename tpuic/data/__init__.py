@@ -1,0 +1,3 @@
+from tpuic.data.folder import ImageFolderDataset  # noqa: F401
+from tpuic.data.pipeline import Loader  # noqa: F401
+from tpuic.data.synthetic import make_synthetic_imagefolder  # noqa: F401

@@ -1,0 +1,107 @@
+"""ImageFolder dataset: index + per-sample load.
+
+Re-design of reference ``ImageDataset`` (dp/loader.py:15-61):
+
+- Layout: ``data_dir/{fold}/{class_name}/{image}.png`` globbed the same way
+  (dp/loader.py:20-21).
+- Class mapping: the reference initializes ``self.mapping = {}`` and never
+  populates it (dp/loader.py:29) — a latent bug that makes ``num_classes`` 0
+  and ``__getitem__`` raise. The intended behavior, built here: class names are
+  the sorted subdirectory names of the TRAIN fold, mapped to contiguous ids
+  (sorted => identical on every host; the train fold is canonical so val
+  shares the mapping).
+- ``image_id``: filename stem (dp/loader.py:43 strips '.png'; here any
+  extension is stripped).
+- The reference shuffles its file list unseeded, per-rank, at init
+  (dp/loader.py:23) — ranks disagree about the index order, so
+  DistributedSampler shards overlap/miss samples. Here the index order is
+  deterministic (sorted); shuffling belongs to the sampler (pipeline.py) with
+  an epoch-folded global seed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from tpuic.config import DataConfig
+from tpuic.data import transforms as T
+
+_IMAGE_EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".webp"}
+
+
+def _is_image(path: str) -> bool:
+    return os.path.splitext(path)[1].lower() in _IMAGE_EXTS
+
+
+class ImageFolderDataset:
+    def __init__(self, data_dir: str, fold: str, resize_size: int,
+                 cfg: Optional[DataConfig] = None,
+                 class_to_idx: Optional[Dict[str, int]] = None) -> None:
+        self.cfg = cfg or DataConfig()
+        self.data_dir = data_dir
+        self.fold = fold
+        self.train = fold == "train"
+        self.resize_size = resize_size
+        root = os.path.join(data_dir, fold)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(f"no such fold: {root}")
+        # Canonical class mapping from the train fold (see module docstring).
+        if class_to_idx is None:
+            map_root = os.path.join(data_dir, "train")
+            if not os.path.isdir(map_root):
+                map_root = root
+            classes = sorted(d for d in os.listdir(map_root)
+                             if os.path.isdir(os.path.join(map_root, d)))
+            class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.class_to_idx: Dict[str, int] = dict(class_to_idx)
+        self.classes: List[str] = sorted(self.class_to_idx,
+                                         key=self.class_to_idx.get)
+        samples: List[Tuple[str, int]] = []
+        for cls in sorted(os.listdir(root)):
+            cdir = os.path.join(root, cls)
+            if not os.path.isdir(cdir) or cls not in self.class_to_idx:
+                continue
+            for fname in sorted(os.listdir(cdir)):
+                fpath = os.path.join(cdir, fname)
+                if _is_image(fpath):
+                    samples.append((fpath, self.class_to_idx[cls]))
+        if not samples:
+            raise ValueError(f"no images under {root}")
+        self.samples = samples
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    @property
+    def num_classes(self) -> int:
+        """Reference dp/loader.py:34-36 (fixed: mapping is populated)."""
+        return len(self.class_to_idx)
+
+    def image_id(self, index: int) -> str:
+        path, _ = self.samples[index]
+        return os.path.splitext(os.path.basename(path))[0]
+
+    def load(self, index: int, rng: Optional[np.random.Generator] = None
+             ) -> Tuple[np.ndarray, int, str]:
+        """Decode → RGB → resize → [augment] → normalize. Returns
+        (HWC float32 image, label, image_id) — reference dp/loader.py:39-61,
+        minus the CHW transpose (TPU convs are NHWC)."""
+        path, label = self.samples[index]
+        with Image.open(path) as im:
+            img = np.asarray(im.convert("RGB") if im.mode not in ("RGB",)
+                             else im)
+        img = T.to_rgb(img)
+        img = T.resize_nearest(img, self.resize_size)
+        if self.train and rng is not None:
+            c = self.cfg
+            img = T.augment(img, rng, p_vflip=c.p_vflip, p_hflip=c.p_hflip,
+                            p_saturation=c.p_saturation,
+                            p_brightness=c.p_brightness,
+                            p_contrast=c.p_contrast, jitter_lo=c.jitter_lo,
+                            jitter_hi=c.jitter_hi)
+        img = T.normalize(img, self.cfg.mean, self.cfg.std)
+        return img, label, self.image_id(index)

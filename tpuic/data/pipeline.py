@@ -1,0 +1,182 @@
+"""Per-host sharded input pipeline with threaded prefetch.
+
+The TPU-native replacement for the reference's
+``DataLoader(num_workers=6, pin_memory=True) + DistributedSampler``
+(train.py:112-118, SURVEY.md §2b):
+
+- **Sampler**: one global, epoch-seeded permutation shared by every host
+  (``set_epoch`` semantics of train.py:164, minus the reference's per-rank
+  unseeded pre-shuffle bug, dp/loader.py:23). The index list is padded by
+  wrapping to a multiple of the global batch — like DistributedSampler — but
+  padded positions carry ``mask=0`` so eval reductions stay exact instead of
+  double-counting duplicates.
+- **Workers**: a thread pool decodes/augments samples (PIL/NumPy release the
+  GIL for the heavy parts); a producer thread assembles batches and keeps a
+  bounded prefetch queue ahead of the device — the analogue of pinned-memory
+  prefetch, feeding ``jax.make_array_from_process_local_data`` so each host
+  only materializes its own shard of the global batch.
+- Per-sample augmentation RNG is ``(seed, epoch, global_index)``-derived:
+  bitwise reproducible regardless of worker count or scheduling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuic.data.folder import ImageFolderDataset
+
+
+class Batch(dict):
+    """dict with .image_ids attached (host-side strings never hit the device;
+    the reference ships image_id through the tensor path, dp/loader.py:61)."""
+    image_ids: List[str]
+
+
+def _epoch_indices(n: int, epoch: int, seed: int, shuffle: bool,
+                   global_batch: int) -> np.ndarray:
+    """Global order for one epoch, padded by wrapping to a batch multiple.
+
+    Returns int64 array whose length is a multiple of global_batch; entries
+    are sample indices, with a parallel validity implied by position >= n
+    after an argsort-free wrap (the caller masks positions >= n of the
+    *unpadded* order)."""
+    if shuffle:
+        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+        order = rng.permutation(n)
+    else:
+        order = np.arange(n)
+    pad = (-n) % global_batch
+    if pad:
+        order = np.concatenate([order, order[:pad]])
+    return order, n  # (padded order, number of valid entries)
+
+
+class Loader:
+    """Iterates globally-sharded device batches for one process.
+
+    global_batch must be divisible by (process_count * local shard layout);
+    each host materializes rows [rank*local : (rank+1)*local] of every global
+    batch, where local = global_batch / process_count.
+    """
+
+    def __init__(self, dataset: ImageFolderDataset, global_batch: int,
+                 mesh: Optional[Mesh] = None, shuffle: Optional[bool] = None,
+                 seed: int = 0, num_workers: int = 6, prefetch: int = 2,
+                 drop_last: bool = False) -> None:
+        self.dataset = dataset
+        self.global_batch = int(global_batch)
+        self.mesh = mesh
+        self.shuffle = dataset.train if shuffle is None else shuffle
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.prefetch = max(1, prefetch)
+        self.drop_last = drop_last
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        if self.global_batch % self.process_count:
+            raise ValueError("global batch must divide across processes")
+        self.local_batch = self.global_batch // self.process_count
+        self._sharding = (NamedSharding(mesh, P("data")) if mesh is not None
+                          else None)
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch
+        return -(-n // self.global_batch)
+
+    def steps_per_epoch(self) -> int:
+        return len(self)
+
+    def _load_one(self, position: int, index: int, valid: bool, epoch: int):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch, int(index)]))
+        img, label, image_id = self.dataset.load(int(index), rng)
+        return position, img, label, image_id, valid
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Yield batches for this epoch (the set_epoch(e) equivalent)."""
+        n = len(self.dataset)
+        order, n_valid = _epoch_indices(n, epoch, self.seed, self.shuffle,
+                                        self.global_batch)
+        n_batches = len(order) // self.global_batch
+        if self.drop_last and n % self.global_batch:
+            n_batches -= 1
+        out_q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """Bounded put that aborts when the consumer abandons the epoch
+            (otherwise the producer would park forever in a full queue)."""
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                _produce_loop()
+                _put(None)
+            except BaseException as e:  # surface worker errors to the consumer
+                _put(e)
+
+        def _produce_loop():
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                for b in range(n_batches):
+                    if stop.is_set():
+                        break
+                    lo = b * self.global_batch + self.process_index * self.local_batch
+                    futs = []
+                    for i in range(self.local_batch):
+                        gpos = lo + i
+                        futs.append(pool.submit(
+                            self._load_one, i, order[gpos],
+                            gpos < n_valid, epoch))
+                    imgs = np.empty((self.local_batch,
+                                     self.dataset.resize_size,
+                                     self.dataset.resize_size, 3), np.float32)
+                    labels = np.zeros((self.local_batch,), np.int32)
+                    mask = np.zeros((self.local_batch,), np.float32)
+                    ids = [""] * self.local_batch
+                    for f in futs:
+                        pos, img, label, image_id, valid = f.result()
+                        imgs[pos] = img
+                        labels[pos] = label
+                        mask[pos] = 1.0 if valid else 0.0
+                        ids[pos] = image_id
+                    if not _put((imgs, labels, mask, ids)):
+                        return
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                imgs, labels, mask, ids = item
+                batch = Batch(image=self._to_global(imgs),
+                              label=self._to_global(labels),
+                              mask=self._to_global(mask))
+                batch.image_ids = ids
+                yield batch
+        finally:
+            stop.set()
+            producer.join(timeout=5.0)
+
+    def _to_global(self, local: np.ndarray):
+        if self._sharding is None:
+            return local
+        return jax.make_array_from_process_local_data(self._sharding, local)

@@ -1,0 +1,45 @@
+"""Synthetic ImageFolder trees + in-memory batches for tests and benches.
+
+The reference has no test assets at all (SURVEY.md §4); these generators stand
+in for the tiny 2-class PNG tree its integration story needs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+from PIL import Image
+
+
+def make_synthetic_imagefolder(root: str, classes: Sequence[str] = ("cat", "dog"),
+                               per_class: int = 8, size: int = 40,
+                               folds: Sequence[str] = ("train", "val"),
+                               seed: int = 0) -> str:
+    """Write data_dir/{fold}/{class}/{class}_{i}.png with class-correlated
+    pixel statistics (so a model can actually overfit it)."""
+    rng = np.random.default_rng(seed)
+    for fold in folds:
+        for ci, cls in enumerate(classes):
+            d = os.path.join(root, fold, cls)
+            os.makedirs(d, exist_ok=True)
+            for i in range(per_class):
+                base = np.full((size, size, 3),
+                               40 + 150 * ci // max(1, len(classes) - 1),
+                               np.uint8)
+                noise = rng.integers(0, 60, (size, size, 3), np.uint8)
+                img = np.clip(base.astype(np.int32) + noise, 0, 255).astype(np.uint8)
+                Image.fromarray(img).save(
+                    os.path.join(d, f"{cls}_{fold}_{i}.png"))
+    return root
+
+
+def synthetic_batch(batch: int, size: int, num_classes: int, seed: int = 0):
+    """Random normalized batch dict for step-level tests/benches."""
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.standard_normal((batch, size, size, 3)).astype(np.float32),
+        "label": rng.integers(0, num_classes, (batch,)).astype(np.int32),
+        "mask": np.ones((batch,), np.float32),
+    }

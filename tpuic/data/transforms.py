@@ -1,0 +1,101 @@
+"""Image transforms: decode, resize, augment, normalize.
+
+Numeric-semantics parity with reference dp/loader.py:39-91, as pure NumPy
+functions with explicit RNG (the reference uses the global ``np.random`` state
+inside fork-server DataLoader workers — unseeded and irreproducible; here every
+sample's augmentation derives from (seed, epoch, index)):
+
+- decode: keep first 3 channels (dp/loader.py:45); grayscale broadcast to 3.
+- resize: nearest-neighbor to (S, S) (cv2.INTER_NEAREST, dp/loader.py:45).
+- augment (train only, dp/loader.py:63-83): random rot90 k∈{0..3}; vertical
+  flip p=.5; horizontal flip p=.5; then an if/elif chain — saturation p=.05,
+  elif brightness p≈.05, elif contrast p≈.05 — factor ~ U[0.9, 1.1). The
+  chain structure (at most ONE color op per sample, with conditional
+  probabilities) is preserved exactly.
+- normalize: /255 then per-channel (x-mean)/std with ImageNet stats
+  (dp/loader.py:86-91).
+
+The color ops (saturation/brightness/contrast) come from a module the
+reference imports but does not ship (``bs.dp.augumentation_utils``,
+dp/loader.py:12); standard definitions (ITU-R 601 luma for
+grayscale blending) are used as the build target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def to_rgb(img: np.ndarray) -> np.ndarray:
+    """HW or HWC uint8 -> HW3, keeping the first 3 channels (dp/loader.py:45)."""
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    return img[:, :, :3]
+
+
+def resize_nearest(img: np.ndarray, size: int) -> np.ndarray:
+    """Nearest-neighbor resize to (size, size); matches cv2.INTER_NEAREST."""
+    h, w = img.shape[:2]
+    if h == size and w == size:
+        return img
+    # cv2 nearest: src index = floor(dst * scale) with scale = src/dst.
+    rows = np.minimum((np.arange(size) * (h / size)).astype(np.int64), h - 1)
+    cols = np.minimum((np.arange(size) * (w / size)).astype(np.int64), w - 1)
+    return img[rows][:, cols]
+
+
+def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
+    """img * factor (float image in [0,255] space)."""
+    return np.clip(img.astype(np.float32) * factor, 0.0, 255.0)
+
+
+def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+    """Blend with the global gray mean."""
+    mean = img.astype(np.float32).mean()
+    return np.clip(mean + (img.astype(np.float32) - mean) * factor, 0.0, 255.0)
+
+
+def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+    """Blend with the per-pixel luma grayscale."""
+    gray = (img.astype(np.float32) @ _LUMA)[..., None]
+    return np.clip(gray + (img.astype(np.float32) - gray) * factor, 0.0, 255.0)
+
+
+def augment(img: np.ndarray, rng: np.random.Generator,
+            p_vflip: float = 0.5, p_hflip: float = 0.5,
+            p_saturation: float = 0.05, p_brightness: float = 0.05,
+            p_contrast: float = 0.05, jitter_lo: float = 0.9,
+            jitter_hi: float = 1.1) -> np.ndarray:
+    """Train-time augmentation chain, reference dp/loader.py:63-83."""
+    k = int(rng.integers(0, 4))  # rot90 k in {0,1,2,3} (dp/loader.py:64-65)
+    if k:
+        img = np.rot90(img, k, axes=(0, 1))
+    if rng.random() < p_vflip:  # dp/loader.py:67-68
+        img = img[::-1, :, :]
+    if rng.random() < p_hflip:  # dp/loader.py:70-71
+        img = img[:, ::-1, :]
+    # if/elif color chain (dp/loader.py:74-81): at most one op fires.
+    r = rng.random()
+    factor = jitter_lo + (jitter_hi - jitter_lo) * rng.random()
+    if r < p_saturation:
+        img = adjust_saturation(img, factor)
+    elif r < p_saturation + p_brightness:
+        img = adjust_brightness(img, factor)
+    elif r < p_saturation + p_brightness + p_contrast:
+        img = adjust_contrast(img, factor)
+    return np.ascontiguousarray(img)
+
+
+def normalize(img: np.ndarray, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> np.ndarray:
+    """/255 then per-channel standardize (dp/loader.py:86-91). HWC float32.
+
+    Output layout stays HWC — TPU conv layout — rather than the reference's
+    CHW transpose (dp/loader.py:59), which exists only for torch convention.
+    """
+    img = img.astype(np.float32) / 255.0
+    return (img - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)

@@ -1,0 +1,2 @@
+from tpuic.metrics.meters import AverageMeter, accuracy  # noqa: F401
+from tpuic.metrics.logging import host0_print, MetricLogger  # noqa: F401

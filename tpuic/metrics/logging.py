@@ -1,0 +1,48 @@
+"""Host-0 logging + scalar metric writer.
+
+The reference's observability is a rank-0-gated tqdm bar and ``print``
+(train.py:39-42, 67-68, 94-95). Here: the same console UX plus a structured
+JSONL scalar log (the reference has none — SURVEY.md §5 'Metrics/logging').
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+def is_host0() -> bool:
+    return jax.process_index() == 0
+
+
+def host0_print(*args, **kwargs) -> None:
+    """print() on process 0 only — reference's ``if args.local_rank == 0`` gate."""
+    if is_host0():
+        print(*args, **kwargs, flush=True)
+
+
+class MetricLogger:
+    """Append-only JSONL scalar writer, active on host 0 only."""
+
+    def __init__(self, log_dir: Optional[str] = None) -> None:
+        self._fh = None
+        if log_dir and is_host0():
+            os.makedirs(log_dir, exist_ok=True)
+            self._fh = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+
+    def write(self, step: int, **scalars) -> None:
+        if self._fh is None:
+            return
+        rec = {"step": step, "time": time.time()}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,45 @@
+"""Metrics primitives.
+
+TPU-native re-design of the reference's ``utils.py``:
+
+- ``AverageMeter`` keeps the exact running val/sum/count/avg semantics of
+  reference utils.py:5-20 but on host floats (the reference feeds it 0-dim CUDA
+  tensors, train.py:64, which silently keeps device sync in the logging path —
+  here device values are fetched once per logging event, never per update).
+- ``accuracy`` is the jit-friendly equivalent of reference utils.py:25-27
+  (``argmax(dim=1) == label``), returning per-sample 0/1 so callers can reduce
+  with ``psum`` instead of the reference's pickle-based ragged all_gather
+  (ddp_utils.py:16-56).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class AverageMeter:
+    """Running average with the reference's update semantics (utils.py:16-20)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample 0/1 correctness; reference utils.py:25-27.
+
+    logits: [B, C] float; labels: [B] int. Returns [B] float32 of 0.0/1.0.
+    """
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)

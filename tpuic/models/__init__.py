@@ -1,0 +1,82 @@
+"""Model registry.
+
+``create_model(name, num_classes)`` is the framework equivalent of
+``Classifier(name, num_classes)`` in reference nn/classifier.py:8-34. Accepted
+names cover the reference's selector strings ('resnet50', 'resnet101',
+'efficientnet-b3', 'inceptionv3') plus the BASELINE.md parity-config additions
+('resnet18', 'efficientnet-b0', 'vit-b16').
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from tpuic.config import ModelConfig
+from tpuic.models.classifier import Classifier
+from tpuic.models import resnet as _resnet
+
+_REGISTRY: Dict[str, Tuple[Callable[..., Any], bool]] = {}
+
+
+def register(name: str, factory: Callable[..., Any], has_aux: bool = False):
+    _REGISTRY[name] = (factory, has_aux)
+
+
+def available_models():
+    return sorted(_REGISTRY)
+
+
+def _dtype(name: str):
+    return jnp.dtype(name)
+
+
+def create_backbone(name: str, *, dtype=jnp.float32, param_dtype=jnp.float32,
+                    bn_momentum: float = 0.9, bn_eps: float = 1e-5):
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model '{name}'; available: {available_models()}")
+    factory, has_aux = _REGISTRY[name]
+    return factory(dtype=dtype, param_dtype=param_dtype,
+                   bn_momentum=bn_momentum, bn_eps=bn_eps), has_aux
+
+
+def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
+                 dtype="bfloat16", param_dtype="float32",
+                 bn_momentum: float = 0.9, bn_eps: float = 1e-5) -> Classifier:
+    dt, pdt = _dtype(dtype), _dtype(param_dtype)
+    backbone, has_aux = create_backbone(name, dtype=dt, param_dtype=pdt,
+                                        bn_momentum=bn_momentum, bn_eps=bn_eps)
+    return Classifier(backbone=backbone, num_classes=num_classes,
+                      head_widths=tuple(head_widths), has_aux=has_aux,
+                      dtype=dt, param_dtype=pdt)
+
+
+def create_model_from_config(cfg: ModelConfig) -> Classifier:
+    return create_model(cfg.name, cfg.num_classes, head_widths=cfg.head_widths,
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps)
+
+
+def _register_builtins():
+    def _rn(factory):
+        def make(*, dtype, param_dtype, bn_momentum, bn_eps):
+            return factory(dtype=dtype, param_dtype=param_dtype,
+                           bn_momentum=bn_momentum, bn_eps=bn_eps)
+        return make
+
+    def _rn_small(factory):
+        def make(*, dtype, param_dtype, bn_momentum, bn_eps):
+            return factory(dtype=dtype, param_dtype=param_dtype,
+                           bn_momentum=bn_momentum, bn_eps=bn_eps,
+                           small_stem=True)
+        return make
+
+    register("resnet18", _rn(_resnet.resnet18))
+    register("resnet34", _rn(_resnet.resnet34))
+    register("resnet50", _rn(_resnet.resnet50))
+    register("resnet101", _rn(_resnet.resnet101))
+    register("resnet18-cifar", _rn_small(_resnet.resnet18))
+
+
+_register_builtins()

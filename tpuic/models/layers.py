@@ -1,0 +1,71 @@
+"""Shared model building blocks.
+
+The MLP classifier head reproduces the reference's
+``in_features -> 128 -> ReLU -> 64 -> ReLU -> 32 -> ReLU -> num_classes`` head
+(nn/classifier.py:26-34). BatchNorm notes:
+
+- The reference converts every BN layer to SyncBatchNorm over the world group
+  (train.py:124), so training statistics are global-batch statistics. In this
+  framework the train step is jitted over a mesh with the batch sharded on the
+  ``data`` axis, so a plain ``nn.BatchNorm`` reduction over the batch dim *is*
+  a global-batch reduction — GSPMD inserts the cross-replica all-reduce.
+  SyncBN is the default semantics here, not an opt-in wrapper.
+- Momentum/eps defaults follow torch BN (momentum 0.1 torch-style == 0.9 flax
+  EMA style; eps 1e-5), which the reference inherits untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLPHead(nn.Module):
+    """Reference nn/classifier.py:26-34 head: widths (128, 64, 32) + ReLU."""
+
+    num_classes: int
+    widths: Sequence[int] = (128, 64, 32)
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i, w in enumerate(self.widths):
+            x = nn.Dense(w, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name=f"fc{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="out")(x)
+        return x.astype(jnp.float32)
+
+
+def batch_norm(train: bool, *, momentum: float = 0.9, eps: float = 1e-5,
+               dtype: Any = jnp.float32, param_dtype: Any = jnp.float32,
+               name: str | None = None) -> nn.BatchNorm:
+    """BatchNorm with torch-default hyperparameters (see module docstring).
+
+    Under the sharded-jit train step this computes *global* batch statistics —
+    the reference's SyncBatchNorm (train.py:124) semantics.
+    """
+    return nn.BatchNorm(use_running_average=not train, momentum=momentum,
+                        epsilon=eps, dtype=dtype, param_dtype=param_dtype,
+                        name=name)
+
+
+Conv = nn.Conv
+
+
+def conv3x3(features: int, strides: int = 1, *, dtype=jnp.float32,
+            param_dtype=jnp.float32, name: str | None = None) -> nn.Conv:
+    return nn.Conv(features, (3, 3), strides=(strides, strides), padding=1,
+                   use_bias=False, dtype=dtype, param_dtype=param_dtype,
+                   name=name)
+
+
+def conv1x1(features: int, strides: int = 1, *, dtype=jnp.float32,
+            param_dtype=jnp.float32, name: str | None = None) -> nn.Conv:
+    return nn.Conv(features, (1, 1), strides=(strides, strides),
+                   use_bias=False, dtype=dtype, param_dtype=param_dtype,
+                   name=name)

@@ -1,0 +1,134 @@
+"""ResNet family (18/34/50/101) as Flax modules.
+
+Backbone capability parity with the reference's torchvision selections
+(nn/classifier.py:11-15 offers resnet50/resnet101 pretrained; BASELINE.md adds
+resnet18 for the CIFAR-10 config). Torchvision's exact architecture is
+reproduced — 7x7/stride-2 stem, maxpool, 4 stages of Basic/Bottleneck blocks,
+global average pool — so its pretrained checkpoints can be converted 1:1
+(tpuic/checkpoint/torch_convert.py). Layout is NHWC (TPU-native; torch is
+NCHW), compute dtype is configurable bfloat16 for the MXU.
+
+A ``small_stem`` variant (3x3 stride-1 stem, no maxpool) is provided for
+32x32 CIFAR inputs, where the ImageNet stem would destroy resolution.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpuic.models.layers import batch_norm, conv1x1, conv3x3
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        bn = partial(batch_norm, train, momentum=self.bn_momentum,
+                     eps=self.bn_eps, dtype=self.dtype,
+                     param_dtype=self.param_dtype)
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        residual = x
+        y = conv3x3(self.features, self.strides, **kw, name="conv1")(x)
+        y = bn(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv3x3(self.features, **kw, name="conv2")(y)
+        y = bn(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv1x1(self.features, self.strides, **kw,
+                               name="downsample_conv")(x)
+            residual = bn(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int  # bottleneck width; block output is 4*features
+    strides: int = 1
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        bn = partial(batch_norm, train, momentum=self.bn_momentum,
+                     eps=self.bn_eps, dtype=self.dtype,
+                     param_dtype=self.param_dtype)
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        out_features = self.features * 4
+        residual = x
+        y = conv1x1(self.features, **kw, name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y))
+        # torchvision places the stride on the 3x3 (v1.5 ResNet).
+        y = conv3x3(self.features, self.strides, **kw, name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y))
+        y = conv1x1(out_features, **kw, name="conv3")(y)
+        y = bn(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv1x1(out_features, self.strides, **kw,
+                               name="downsample_conv")(x)
+            residual = bn(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Returns pooled features [B, F]; the classifier head is separate."""
+
+    stage_sizes: Sequence[int]
+    block: type
+    num_filters: int = 64
+    small_stem: bool = False
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        x = x.astype(self.dtype)
+        if self.small_stem:
+            x = nn.Conv(self.num_filters, (3, 3), padding=1, use_bias=False,
+                        **kw, name="conv1")(x)
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2), padding=3,
+                        use_bias=False, **kw, name="conv1")(x)
+        x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
+                       **kw, name="bn1")(x)
+        x = nn.relu(x)
+        if not self.small_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for i in range(n_blocks):
+                strides = 2 if stage > 0 and i == 0 else 1
+                x = self.block(self.num_filters * 2 ** stage, strides,
+                               self.bn_momentum, self.bn_eps, self.dtype,
+                               self.param_dtype,
+                               name=f"layer{stage + 1}_{i}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block=Bottleneck, **kw)

@@ -1,0 +1,3 @@
+from tpuic.parallel.collectives import (  # noqa: F401
+    pmean_tree, psum_scalar, global_mean, all_gather_batch,
+)

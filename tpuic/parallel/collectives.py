@@ -1,0 +1,53 @@
+"""Collective utilities — the TPU-native descendant of reference ddp_utils.py.
+
+Design shift (SURVEY.md §2b): the reference issues eager NCCL collectives from
+Python — ``reduce_tensor`` (clone → all_reduce SUM → /world_size,
+ddp_utils.py:8-12) and a pickle-based variable-size object ``all_gather``
+(ddp_utils.py:16-56, used to collect ragged per-sample accuracy lists). Under
+SPMD all shapes are static and collectives are *traced*, not issued, so:
+
+- ``reduce_tensor``   → ``global_mean`` (lax.pmean inside the jitted step)
+- ragged all_gather   → fixed-shape ``psum`` of (correct_count, total_count)
+                        pairs, or ``all_gather_batch`` when per-sample values
+                        really are needed (static shapes make padding explicit)
+
+These helpers only work inside shard_map/pmapped code where the axis name is
+bound; that is intentional — there is no eager collective path on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pmean_tree(tree, axis_name: str = "data"):
+    """Mean-all-reduce every leaf of a pytree across the named mesh axis.
+
+    The gradient-averaging equivalent of DDP's bucketed all-reduce
+    (reference train.py:128). XLA's latency-hiding scheduler overlaps these
+    reductions with the backward computation, which is the compiled analogue
+    of DDP's bucket/backward overlap.
+    """
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def psum_scalar(x, axis_name: str = "data"):
+    """Sum-reduce a scalar across the axis (reference ddp_utils.py:10 SUM)."""
+    return lax.psum(x, axis_name)
+
+
+def global_mean(x, axis_name: str = "data"):
+    """Mean across the axis — reference train.py:61-63 (all_reduce/world_size)."""
+    return lax.pmean(x, axis_name)
+
+
+def all_gather_batch(x, axis_name: str = "data"):
+    """Gather per-shard arrays into one leading-device-axis array.
+
+    Fixed-shape replacement for the pickle all_gather (ddp_utils.py:16-56):
+    callers pad to a static per-shard size and carry a validity mask instead of
+    gathering ragged lists.
+    """
+    return lax.all_gather(x, axis_name, tiled=True)

@@ -1,0 +1,2 @@
+from tpuic.runtime.distributed import initialize, runtime_info  # noqa: F401
+from tpuic.runtime.mesh import make_mesh, data_sharding, replicated_sharding  # noqa: F401

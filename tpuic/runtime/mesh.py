@@ -1,0 +1,69 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's topology model is one process per GPU with a fully replicated
+model (DDP, train.py:128). The TPU-native model is a named
+``jax.sharding.Mesh`` over the pod slice with a ``data`` axis (batch sharding —
+the DDP equivalent) and a ``model`` axis (tensor sharding — reserved so TP is a
+config change, SURVEY.md §2c). ``jax.make_mesh`` lays the axes onto the
+physical ICI torus so the heavy ``data``-axis collectives ride neighbor links.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuic.config import MeshConfig
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, model) mesh over all devices.
+
+    cfg.data == 0 infers the data-axis size as n_devices / model. jax.make_mesh
+    picks an ICI-friendly device order on real TPU slices; on CPU test meshes
+    the order is row-major over jax.devices().
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = max(1, cfg.model)
+    if n % model:
+        raise ValueError(f"model axis {model} does not divide device count {n}")
+    data = cfg.data or n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != device count {n}")
+    # Auto axis types: shardings constrain data layout and GSPMD propagates /
+    # inserts collectives (jax>=0.9 defaults make_mesh to Explicit
+    # sharding-in-types, which instead demands out_sharding annotations on
+    # every contraction touching a sharded dim — not the model we want).
+    auto = (jax.sharding.AxisType.Auto,) * 2
+    try:
+        return jax.make_mesh((data, model), tuple(cfg.axis_names),
+                             axis_types=auto, devices=devices)
+    except TypeError:
+        # Older signature without axis_types/devices kwargs.
+        arr = np.asarray(devices).reshape(data, model)
+        return Mesh(arr, tuple(cfg.axis_names))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the data axis — the DDP-equivalent layout."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated layout (params/opt state under pure DP)."""
+    return NamedSharding(mesh, P())
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
+    """Per-process share of a global batch under data sharding."""
+    procs = jax.process_count()
+    if global_batch % procs:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{procs} processes")
+    return global_batch // procs

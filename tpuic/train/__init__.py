@@ -1,0 +1,6 @@
+from tpuic.train.loss import weighted_cross_entropy  # noqa: F401
+from tpuic.train.schedule import multistep_schedule  # noqa: F401
+from tpuic.train.optimizer import make_optimizer  # noqa: F401
+from tpuic.train.state import TrainState, create_train_state  # noqa: F401
+from tpuic.train.step import make_train_step, make_eval_step  # noqa: F401
+from tpuic.train.loop import Trainer  # noqa: F401

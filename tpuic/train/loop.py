@@ -1,0 +1,149 @@
+"""Epoch driver — the re-design of reference train.py:99-188.
+
+Maps 1:1 onto the reference's flow with the TPU-shaped replacements:
+
+| reference                                  | here                              |
+|--------------------------------------------|-----------------------------------|
+| init_process_group('nccl') (train.py:102)  | runtime.initialize() + make_mesh  |
+| DataLoader + DistributedSampler (112-118)  | tpuic.data.Loader (sharded)       |
+| Classifier + SyncBN + DDP (122-128)        | create_model + sharded jit step   |
+| checkpoint probe/partial load (131-153)    | CheckpointManager.restore_into    |
+| MultiStepLR + weighted CE (156-158)        | optax schedule + loss config      |
+| for epoch in range(100) (161)              | fit() — resumes at saved epoch    |
+| train_epoch / val_epoch (36-97)            | train_epoch / val_epoch           |
+| best/latest saves (173-188)                | save_best / maybe_save_latest     |
+
+Progress UX matches the reference: host-0 tqdm bar with description
+``Epoch: {e}; Loss {val:.4f}|({avg:.4f})`` (train.py:67-68) and val print
+(train.py:94-95). The displayed loss is already the global mean — the step
+computes it over the global batch, so no extra logging collective exists.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+from tqdm import tqdm
+
+from tpuic.checkpoint.manager import CheckpointManager
+from tpuic.config import Config
+from tpuic.data.folder import ImageFolderDataset
+from tpuic.data.pipeline import Loader
+from tpuic.metrics.logging import MetricLogger, host0_print, is_host0
+from tpuic.metrics.meters import AverageMeter
+from tpuic.models import create_model_from_config
+from tpuic.runtime.mesh import make_mesh
+from tpuic.train.optimizer import make_optimizer, make_schedule
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_eval_step, make_train_step
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None, log_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        d = cfg.data
+        self.train_ds = ImageFolderDataset(d.data_dir, "train", d.resize_size, d)
+        self.val_ds = ImageFolderDataset(d.data_dir, "val", d.resize_size, d,
+                                         class_to_idx=self.train_ds.class_to_idx)
+        n_data = self.mesh.shape["data"]
+        global_batch = d.batch_size * n_data
+        self.train_loader = Loader(self.train_ds, global_batch, self.mesh,
+                                   seed=d.shuffle_seed, num_workers=d.num_workers,
+                                   prefetch=d.prefetch, drop_last=True)
+        self.val_loader = Loader(self.val_ds,
+                                 d.resolved_val_batch_size() * n_data,
+                                 self.mesh, shuffle=False,
+                                 num_workers=d.num_workers, prefetch=d.prefetch)
+        num_classes = cfg.model.num_classes or self.train_ds.num_classes
+        mcfg = cfg.model
+        if num_classes != mcfg.num_classes:
+            import dataclasses
+            mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
+        self.model = create_model_from_config(mcfg)
+        steps = max(1, self.train_loader.steps_per_epoch())
+        self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs)
+        tx = make_optimizer(cfg.optim, steps, cfg.run.epochs)
+        shape = (global_batch, d.resize_size, d.resize_size, 3)
+        with self.mesh:
+            self.state = create_train_state(
+                self.model, tx, jax.random.key(cfg.run.seed), shape)
+        self.train_step = make_train_step(cfg.optim, mcfg, self.mesh,
+                                          lr_schedule=self.schedule)
+        self.eval_step = make_eval_step(cfg.optim, mcfg, self.mesh)
+        self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
+                                      cfg.run.save_period)
+        self.logger = MetricLogger(log_dir)
+        self.start_epoch = 0
+        self.best_score = 0.0
+        if cfg.run.resume:
+            self.state, self.start_epoch, self.best_score = \
+                self.ckpt.restore_into(self.state, "best")
+
+    # -- epochs -------------------------------------------------------------
+    def train_epoch(self, epoch: int) -> float:
+        """Reference train_epoch (train.py:36-73)."""
+        losses = AverageMeter()
+        it = self.train_loader.epoch(epoch)
+        bar = tqdm(it, total=len(self.train_loader), disable=not is_host0())
+        metrics = None
+        for step, batch in enumerate(bar):
+            self.state, metrics = self.train_step(
+                self.state, {k: batch[k] for k in ("image", "label", "mask")})
+            if (step + 1) % self.cfg.run.log_every_steps == 0:
+                loss = float(metrics["loss"])  # global mean, device sync point
+                losses.update(loss, 1)
+                bar.set_description(
+                    f"Epoch: {epoch}; Loss {losses.val:.4f}|({losses.avg:.4f})")
+                self.logger.write(int(jax.device_get(self.state.step)),
+                                  loss=loss,
+                                  accuracy=float(metrics["accuracy"]),
+                                  lr=float(metrics.get("lr", 0.0)))
+        return losses.avg
+
+    def val_epoch(self, epoch: int) -> float:
+        """Reference val_epoch (train.py:78-97): exact global accuracy ×100,
+        plus the exact global weighted val CE (num/den accumulated
+        separately)."""
+        correct = count = loss_num = loss_den = 0.0
+        for batch in self.val_loader.epoch(epoch):
+            m = self.eval_step(self.state,
+                               {k: batch[k] for k in ("image", "label", "mask")})
+            correct += float(m["correct"])
+            count += float(m["count"])
+            loss_num += float(m["loss_num"])
+            loss_den += float(m["loss_den"])
+        score = 100.0 * correct / max(count, 1.0)
+        val_loss = loss_num / max(loss_den, 1e-12)
+        host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}; "
+                    f"Val Loss {val_loss:.4f}")
+        self.logger.write(int(jax.device_get(self.state.step)),
+                          val_accuracy=score, val_loss=val_loss)
+        return score
+
+    # -- driver -------------------------------------------------------------
+    def fit(self, epochs: Optional[int] = None) -> float:
+        epochs = epochs if epochs is not None else self.cfg.run.epochs
+        best = self.best_score
+        profiled = False
+        for epoch in range(self.start_epoch, epochs):
+            if (self.cfg.run.profile_dir and not profiled
+                    and epoch == self.start_epoch):
+                jax.profiler.start_trace(self.cfg.run.profile_dir)
+                profiled = True
+            t0 = time.time()
+            self.train_epoch(epoch)
+            score = self.val_epoch(epoch)
+            host0_print(f"Epoch {epoch} took {time.time() - t0:.1f}s")
+            if profiled:
+                jax.profiler.stop_trace()
+                profiled = False
+            if score > best:
+                best = score
+                self.ckpt.save_best(self.state, epoch, best)
+            self.ckpt.maybe_save_latest(self.state, epoch, best)
+        self.best_score = best
+        return best

@@ -1,0 +1,48 @@
+"""Optimizers.
+
+The reference uses replicated Adam(lr=0.5e-5) on every rank (train.py:127);
+here the optimizer is an optax transform applied inside the sharded jitted
+step (the update math itself is compiled and, under TP/FSDP-style param
+sharding, computed shard-locally — no redundant full-replica update).
+LARS covers BASELINE.md config 5 (large-batch ResNet-50).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from tpuic.config import OptimConfig
+from tpuic.train import schedule as sched
+
+
+def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int) -> optax.Schedule:
+    if cfg.warmup_epochs > 0:
+        return sched.warmup_cosine_schedule(cfg.learning_rate, cfg.warmup_epochs,
+                                            total_epochs, steps_per_epoch)
+    if cfg.milestones:
+        return sched.multistep_schedule(cfg.learning_rate, cfg.milestones,
+                                        cfg.gamma, steps_per_epoch)
+    return sched.constant_schedule(cfg.learning_rate)
+
+
+def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
+                   total_epochs: int = 100) -> optax.GradientTransformation:
+    lr = make_schedule(cfg, steps_per_epoch, total_epochs)
+    name = cfg.optimizer.lower()
+    if name == "adam":
+        tx = optax.adam(lr)
+        if cfg.weight_decay:
+            tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    elif name == "lars":
+        tx = optax.lars(lr, weight_decay=cfg.weight_decay,
+                        trust_coefficient=cfg.lars_trust_coefficient,
+                        momentum=cfg.lars_momentum)
+    elif name == "sgd":
+        tx = optax.sgd(lr, momentum=0.9)
+        if cfg.weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    else:
+        raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
+    if cfg.grad_clip_norm:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    return tx

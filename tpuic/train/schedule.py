@@ -1,0 +1,36 @@
+"""Learning-rate schedules.
+
+``multistep_schedule`` reproduces torch ``MultiStepLR(milestones=[50,80],
+gamma=0.5)`` stepped once per epoch (reference train.py:156, 166), expressed as
+a per-step optax schedule (the jitted step owns the LR, so the schedule is a
+pure function of the global step — no Python-side ``scheduler.step()``).
+Warmup + cosine covers the large-batch LARS config (BASELINE.md config 5,
+Goyal-style linear warmup).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import optax
+
+
+def multistep_schedule(base_lr: float, milestones: Sequence[int],
+                       gamma: float, steps_per_epoch: int) -> optax.Schedule:
+    """lr * gamma^(number of milestone epochs passed)."""
+    boundaries = {int(m) * steps_per_epoch: gamma for m in milestones}
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def warmup_cosine_schedule(base_lr: float, warmup_epochs: int, total_epochs: int,
+                           steps_per_epoch: int, end_lr: float = 0.0) -> optax.Schedule:
+    warmup_steps = warmup_epochs * steps_per_epoch
+    total_steps = max(total_epochs * steps_per_epoch, warmup_steps + 1)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=base_lr, warmup_steps=max(warmup_steps, 1),
+        decay_steps=total_steps, end_value=end_lr)
+
+
+def constant_schedule(base_lr: float) -> optax.Schedule:
+    return optax.constant_schedule(base_lr)

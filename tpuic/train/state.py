@@ -1,0 +1,54 @@
+"""Train state: params + batch_stats + optimizer state + step.
+
+The reference's mutable training state is spread across the DDP module's
+parameters, BN running stats buried in module buffers, replicated Adam state,
+and Python-side ``start_epoch``/``best_score`` (train.py:127-150). Here it is
+one immutable pytree, which is what makes sharding, donation, and
+checkpointing uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)
+
+
+def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
+                       input_shape, train: bool = True) -> TrainState:
+    """Initialize params/batch_stats with a dummy batch of ``input_shape``.
+
+    The batch dim is forced to 1: param shapes don't depend on it, and a
+    global-batch-sized unsharded dummy would OOM device 0 at pod scale.
+    """
+    dummy = jnp.zeros((1,) + tuple(input_shape[1:]), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    params = variables.get("params", FrozenDict())
+    batch_stats = variables.get("batch_stats", FrozenDict())
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )

@@ -1,0 +1,1 @@
+from tpuic.utils.trees import tree_size, tree_bytes  # noqa: F401
