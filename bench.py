@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 train-step throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": M}
+
+The reference publishes no numbers (BASELINE.md: `published: {}`), so
+``vs_baseline`` is anchored to the driver's north star — ≥70% MFU on the
+tracking config — as achieved_MFU / 0.70. FLOPs per step are taken from
+XLA's compiled cost analysis, not a hand model.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets).
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "cpu": 1e12,             # nominal, keeps the metric finite in CI
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 1e12
+
+
+def main() -> None:
+    from tpuic.config import MeshConfig, ModelConfig, OptimConfig
+    from tpuic.data.synthetic import synthetic_batch
+    from tpuic.models import create_model
+    from tpuic.runtime.mesh import make_mesh
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+    from tpuic.train.step import make_train_step
+
+    n_chips = jax.device_count()
+    # Mesh only when there is something to shard over (on the tunneled
+    # single-chip dev platform SPMD executables dispatch ~100x slower).
+    mesh = make_mesh(MeshConfig()) if n_chips > 1 else None
+    mcfg = ModelConfig(name="resnet50", num_classes=1000, dtype="bfloat16")
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=())
+    size, per_chip_batch = 224, 64
+    global_batch = per_chip_batch * n_chips
+
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype)
+    state = create_train_state(model, make_optimizer(ocfg), jax.random.key(0),
+                               (global_batch, size, size, 3))
+    batch = synthetic_batch(global_batch, size, mcfg.num_classes)
+    if mesh is not None:
+        sh = jax.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+    else:
+        batch = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.items()}
+    step = make_train_step(ocfg, mcfg, mesh, donate=True)
+
+    # FLOPs per step from the compiled executable.
+    try:
+        flops_per_step = float(
+            step.lower(state, batch).compile().cost_analysis()["flops"])
+    except Exception:
+        flops_per_step = 3 * 2 * 4.1e9 * global_batch / 2  # fwd+bwd estimate
+
+    # Warmup (compile) then timed steps. Completion is forced with a scalar
+    # device->host readback: on the tunneled dev platform block_until_ready
+    # returns before execution finishes, silently inflating throughput.
+    state, m = step(state, batch)
+    float(m["loss"])
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = n_steps / dt
+    images_per_sec = steps_per_sec * global_batch
+    images_per_sec_per_chip = images_per_sec / n_chips
+    peak = _peak_flops(jax.devices()[0]) * n_chips
+    mfu = flops_per_step * steps_per_sec / peak
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.70, 4),
+        "detail": {
+            "mfu": round(mfu, 4),
+            "global_batch": global_batch,
+            "n_chips": n_chips,
+            "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+            "flops_per_step": flops_per_step,
+            "step_time_ms": round(1000 * dt / n_steps, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""train.py CLI: flag parsing -> Config mapping (reference train.py:27-31
+flags + the hard-coded constants as defaults)."""
+
+import train as cli
+
+
+def test_reference_defaults_map_to_config():
+    args = cli.build_parser().parse_args(["--datadir", "/d"])
+    cfg = cli.config_from_args(args)
+    assert cfg.data.data_dir == "/d"
+    assert cfg.data.batch_size == 4          # train.py:30
+    assert cfg.data.resize_size == 299       # train.py:110
+    assert cfg.optim.learning_rate == 0.5e-5  # train.py:127
+    assert tuple(cfg.optim.milestones) == (50, 80)  # train.py:156
+    assert cfg.optim.class_weights == (3, 3, 10, 1, 4, 4, 5)  # train.py:157
+    assert cfg.run.epochs == 100             # train.py:161
+    assert cfg.run.ckpt_dir == "dtmodel/cp"  # train.py:136
+    assert cfg.run.save_period == 5          # train.py:183
+    assert cfg.data.num_workers == 6         # train.py:114
+
+
+def test_local_rank_accepted_for_compat():
+    # reference launch command passes --local_rank (README.md:6, train.py:28)
+    args = cli.build_parser().parse_args(
+        ["--datadir", "/d", "--local_rank", "3"])
+    assert args.local_rank == 3
+
+
+def test_no_class_weights_flag():
+    args = cli.build_parser().parse_args(
+        ["--datadir", "/d", "--no-class-weights"])
+    assert cli.config_from_args(args).optim.class_weights == ()
+
+
+def test_empty_milestones():
+    args = cli.build_parser().parse_args(["--datadir", "/d", "--milestones"])
+    assert cli.config_from_args(args).optim.milestones == ()

@@ -45,18 +45,23 @@ class Trainer:
     def __init__(self, cfg: Config, mesh=None, log_dir: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        # On a single device the mesh adds nothing — and on the tunneled
+        # single-chip dev platform, SPMD-annotated executables take a ~100x
+        # slower dispatch path — so sharding machinery engages only when
+        # there is something to shard over.
+        step_mesh = self.mesh if self.mesh.size > 1 else None
         d = cfg.data
         self.train_ds = ImageFolderDataset(d.data_dir, "train", d.resize_size, d)
         self.val_ds = ImageFolderDataset(d.data_dir, "val", d.resize_size, d,
                                          class_to_idx=self.train_ds.class_to_idx)
         n_data = self.mesh.shape["data"]
         global_batch = d.batch_size * n_data
-        self.train_loader = Loader(self.train_ds, global_batch, self.mesh,
+        self.train_loader = Loader(self.train_ds, global_batch, step_mesh,
                                    seed=d.shuffle_seed, num_workers=d.num_workers,
                                    prefetch=d.prefetch, drop_last=True)
         self.val_loader = Loader(self.val_ds,
                                  d.resolved_val_batch_size() * n_data,
-                                 self.mesh, shuffle=False,
+                                 step_mesh, shuffle=False,
                                  num_workers=d.num_workers, prefetch=d.prefetch)
         num_classes = cfg.model.num_classes or self.train_ds.num_classes
         mcfg = cfg.model
@@ -71,9 +76,9 @@ class Trainer:
         with self.mesh:
             self.state = create_train_state(
                 self.model, tx, jax.random.key(cfg.run.seed), shape)
-        self.train_step = make_train_step(cfg.optim, mcfg, self.mesh,
+        self.train_step = make_train_step(cfg.optim, mcfg, step_mesh,
                                           lr_schedule=self.schedule)
-        self.eval_step = make_eval_step(cfg.optim, mcfg, self.mesh)
+        self.eval_step = make_eval_step(cfg.optim, mcfg, step_mesh)
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
         self.logger = MetricLogger(log_dir)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""CLI entry point — the TPU-native counterpart of reference train.py.
+
+The reference is launched as
+``python -m torch.distributed.launch --nproc_pre_node=4 train.py --datadir …``
+(reference README.md:6) with three flags (train.py:27-31). Here a single
+process per host drives all local TPU chips; multi-host pods need no launcher
+flags at all (the TPU runtime carries the topology — tpuic/runtime/
+distributed.py). Every constant the reference hard-codes is a flag with the
+same default (see tpuic/config.py for the line-by-line mapping).
+
+Examples:
+  python train.py --datadir /data/imagefolder                 # reference defaults
+  python train.py --datadir /data/cifar --model resnet18-cifar \
+      --resize 32 --batchsize 128 --lr 1e-3 --no-class-weights
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                          OptimConfig, RunConfig)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    # The reference's three flags (train.py:27-31).
+    p.add_argument("--datadir", required=True, help="ImageFolder root with train/ and val/")
+    p.add_argument("--batchsize", type=int, default=4,
+                   help="per-device batch size (reference default 4)")
+    p.add_argument("--local_rank", type=int, default=0,
+                   help="accepted for launch-command compatibility; unused — "
+                        "one JAX process drives all local chips")
+    # Everything the reference hard-codes (train.py:110-183).
+    p.add_argument("--model", default="resnet50",
+                   help="backbone name (see tpuic.models.available_models())")
+    p.add_argument("--num-classes", type=int, default=0,
+                   help="0 = infer from the folder tree")
+    p.add_argument("--resize", type=int, default=299)
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.5e-5)
+    p.add_argument("--optimizer", default="adam", choices=["adam", "lars", "sgd"])
+    p.add_argument("--milestones", type=int, nargs="*", default=[50, 80])
+    p.add_argument("--gamma", type=float, default=0.5)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--warmup-epochs", type=int, default=0)
+    p.add_argument("--class-weights", type=float, nargs="*",
+                   default=[3, 3, 10, 1, 4, 4, 5],
+                   help="CE class weights (reference train.py:157)")
+    p.add_argument("--no-class-weights", action="store_true")
+    p.add_argument("--ckpt-dir", default="dtmodel/cp")
+    p.add_argument("--save-period", type=int, default=5)
+    p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--workers", type=int, default=6)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model-axis", type=int, default=1,
+                   help="mesh model-axis size (1 = pure data parallel)")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the first epoch here")
+    p.add_argument("--log-dir", default="", help="metrics.jsonl directory")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    weights = () if args.no_class_weights else tuple(args.class_weights)
+    return Config(
+        data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
+                        batch_size=args.batchsize, num_workers=args.workers),
+        model=ModelConfig(name=args.model, num_classes=args.num_classes,
+                          dtype=args.dtype),
+        optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
+                          milestones=tuple(args.milestones), gamma=args.gamma,
+                          class_weights=weights,
+                          weight_decay=args.weight_decay,
+                          warmup_epochs=args.warmup_epochs),
+        run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
+                      save_period=args.save_period, resume=not args.no_resume,
+                      profile_dir=args.profile_dir, seed=args.seed),
+        mesh=MeshConfig(model=args.model_axis),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from tpuic.metrics.logging import host0_print
+    from tpuic.runtime.distributed import initialize
+    from tpuic.train.loop import Trainer
+
+    info = initialize()
+    host0_print(f"[tpuic] {info.process_count} process(es), "
+                f"{info.global_device_count} {info.platform} device(s)")
+    cfg = config_from_args(args)
+    trainer = Trainer(cfg, log_dir=args.log_dir or None)
+    host0_print(f"[tpuic] model={trainer.model.backbone.__class__.__name__} "
+                f"classes={trainer.model.num_classes} "
+                f"mesh={dict(trainer.mesh.shape)}")
+    best = trainer.fit()
+    host0_print(f"[tpuic] done; best val accuracy {best:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
