@@ -1,0 +1,101 @@
+"""EfficientNet / ViT / Inception-v3 backbones: shapes, aux head, train mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.models import available_models, create_model
+
+
+def test_registry_covers_reference_and_baseline_selectors():
+    names = available_models()
+    # Reference selector strings (nn/classifier.py:11-23):
+    for n in ["resnet50", "resnet101", "inceptionv3", "efficientnet-b3"]:
+        assert n in names
+    # BASELINE.md parity additions:
+    for n in ["resnet18", "efficientnet-b0", "vit-b16"]:
+        assert n in names
+
+
+def test_efficientnet_b0_shapes():
+    model = create_model("efficientnet-b0", 5, dtype="float32")
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 5)
+    # B0 head width is 1280.
+    assert variables["params"]["head"]["fc0"]["kernel"].shape == (1280, 128)
+
+
+def test_efficientnet_train_mode_with_droppath():
+    model = create_model("efficientnet-b0", 3, dtype="float32")
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    out, mutated = model.apply(variables, x, train=True,
+                               mutable=["batch_stats"],
+                               rngs={"dropout": jax.random.key(1)})
+    assert out.shape == (2, 3)
+    assert "batch_stats" in mutated
+
+
+def test_vit_tiny_shapes_no_batch_stats():
+    model = create_model("vit-tiny", 4, dtype="float32")
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    assert "batch_stats" not in variables  # LayerNorm only
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 4)
+
+
+def test_vit_b16_token_count():
+    # 224/16 = 14 -> 196 patches + CLS = 197 tokens (SURVEY.md §5).
+    from tpuic.models.vit import vit_b16
+    model = vit_b16(dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), x, train=False))
+    assert variables["params"]["pos_embed"].shape == (1, 197, 768)
+
+
+def test_inception_aux_in_train_mode_only():
+    model = create_model("inceptionv3", 7, dtype="float32")
+    x = jnp.zeros((1, 299, 299, 3), jnp.float32)
+    variables = model.init({"params": jax.random.key(0),
+                            "dropout": jax.random.key(1)}, x, train=True)
+    # Eval: single logits [B, 7].
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 7)
+    # Train: (logits, aux_logits) — reference train.py:48-52 consumes both.
+    out, _ = model.apply(variables, x, train=True, mutable=["batch_stats"],
+                         rngs={"dropout": jax.random.key(0)})
+    main, aux = out
+    assert main.shape == (1, 7) and aux.shape == (1, 7)
+
+
+def test_inception_feature_width_is_2048():
+    model = create_model("inceptionv3", 7, dtype="float32")
+    x = jnp.zeros((1, 299, 299, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), x, train=False))
+    assert variables["params"]["head"]["fc0"]["kernel"].shape == (2048, 128)
+
+
+def test_train_step_with_inception_aux_loss():
+    """The full aux-loss path through the compiled step (train.py:48-56)."""
+    from tpuic.config import ModelConfig, OptimConfig
+    from tpuic.data.synthetic import synthetic_batch
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+    from tpuic.train.step import make_train_step
+
+    mcfg = ModelConfig(name="inceptionv3", num_classes=7, dtype="float32")
+    ocfg = OptimConfig()  # reference defaults incl. 7-class weights
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype)
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (1, 299, 299, 3))
+    step = make_train_step(ocfg, mcfg, mesh=None, donate=False)
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(1, 299, 7).items()}
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(new_state.step) == 1

@@ -55,10 +55,8 @@ class DataConfig:
 class ModelConfig:
     """Model settings (reference nn/classifier.py + train.py:122-123)."""
 
-    # Backbone name; the reference hard-codes 'inceptionv3' (train.py:122) —
-    # that becomes the default once the Inception backbone lands in the
-    # registry; until then the flagship ResNet-50 is the default.
-    name: str = "resnet50"
+    # Backbone name; reference default 'inceptionv3' (train.py:122).
+    name: str = "inceptionv3"
     num_classes: int = 7
     # MLP head widths (reference nn/classifier.py:26-34: in->128->64->32->n).
     head_widths: Sequence[int] = (128, 64, 32)

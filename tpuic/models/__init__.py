@@ -3,8 +3,9 @@
 ``create_model(name, num_classes)`` is the framework equivalent of
 ``Classifier(name, num_classes)`` in reference nn/classifier.py:8-34. Accepted
 names cover the reference's selector strings ('resnet50', 'resnet101',
-'efficientnet-b3', 'inceptionv3') plus the BASELINE.md parity-config additions
-('resnet18', 'efficientnet-b0', 'vit-b16').
+'inceptionv3', 'efficientnet-b3' — nn/classifier.py:11-23) plus the
+BASELINE.md parity-config additions ('resnet18', 'efficientnet-b0',
+'vit-b16').
 """
 
 from __future__ import annotations
@@ -16,7 +17,12 @@ import jax.numpy as jnp
 from tpuic.config import ModelConfig
 from tpuic.models.classifier import Classifier
 from tpuic.models import resnet as _resnet
+from tpuic.models import efficientnet as _effnet
+from tpuic.models import inception as _inception
+from tpuic.models import vit as _vit
 
+# name -> (factory(num_classes, dtype, param_dtype, bn_momentum, bn_eps),
+#          has_aux)
 _REGISTRY: Dict[str, Tuple[Callable[..., Any], bool]] = {}
 
 
@@ -28,24 +34,23 @@ def available_models():
     return sorted(_REGISTRY)
 
 
-def _dtype(name: str):
-    return jnp.dtype(name)
-
-
-def create_backbone(name: str, *, dtype=jnp.float32, param_dtype=jnp.float32,
-                    bn_momentum: float = 0.9, bn_eps: float = 1e-5):
+def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
+                    param_dtype=jnp.float32, bn_momentum: float = 0.9,
+                    bn_eps: float = 1e-5):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     factory, has_aux = _REGISTRY[name]
-    return factory(dtype=dtype, param_dtype=param_dtype,
-                   bn_momentum=bn_momentum, bn_eps=bn_eps), has_aux
+    return factory(num_classes=num_classes, dtype=dtype,
+                   param_dtype=param_dtype, bn_momentum=bn_momentum,
+                   bn_eps=bn_eps), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  dtype="bfloat16", param_dtype="float32",
                  bn_momentum: float = 0.9, bn_eps: float = 1e-5) -> Classifier:
-    dt, pdt = _dtype(dtype), _dtype(param_dtype)
-    backbone, has_aux = create_backbone(name, dtype=dt, param_dtype=pdt,
+    dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
+    backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
+                                        param_dtype=pdt,
                                         bn_momentum=bn_momentum, bn_eps=bn_eps)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
@@ -59,24 +64,47 @@ def create_model_from_config(cfg: ModelConfig) -> Classifier:
 
 
 def _register_builtins():
-    def _rn(factory):
-        def make(*, dtype, param_dtype, bn_momentum, bn_eps):
+    def _rn(factory, **extra):
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
+            del num_classes
             return factory(dtype=dtype, param_dtype=param_dtype,
-                           bn_momentum=bn_momentum, bn_eps=bn_eps)
-        return make
-
-    def _rn_small(factory):
-        def make(*, dtype, param_dtype, bn_momentum, bn_eps):
-            return factory(dtype=dtype, param_dtype=param_dtype,
-                           bn_momentum=bn_momentum, bn_eps=bn_eps,
-                           small_stem=True)
+                           bn_momentum=bn_momentum, bn_eps=bn_eps, **extra)
         return make
 
     register("resnet18", _rn(_resnet.resnet18))
     register("resnet34", _rn(_resnet.resnet34))
     register("resnet50", _rn(_resnet.resnet50))
     register("resnet101", _rn(_resnet.resnet101))
-    register("resnet18-cifar", _rn_small(_resnet.resnet18))
+    register("resnet18-cifar", _rn(_resnet.resnet18, small_stem=True))
+
+    def _eff(variant):
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
+            del num_classes, bn_eps  # torch effnet uses eps 1e-3 (module default)
+            return _effnet.efficientnet(variant, dtype=dtype,
+                                        param_dtype=param_dtype,
+                                        bn_momentum=bn_momentum)
+        return make
+
+    for v in ("b0", "b1", "b2", "b3"):
+        register(f"efficientnet-{v}", _eff(v))
+
+    def _vit_factory(ctor):
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
+            del num_classes, bn_momentum, bn_eps  # no BN in ViT
+            return ctor(dtype=dtype, param_dtype=param_dtype)
+        return make
+
+    register("vit-b16", _vit_factory(_vit.vit_b16))
+    register("vit-s16", _vit_factory(_vit.vit_s16))
+    register("vit-tiny", _vit_factory(_vit.vit_tiny))
+
+    def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
+        del bn_eps  # torch inception uses eps 1e-3 (module default)
+        return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
+                                      param_dtype=param_dtype,
+                                      bn_momentum=bn_momentum)
+
+    register("inceptionv3", _inc, has_aux=True)
 
 
 _register_builtins()

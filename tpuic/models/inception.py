@@ -1,0 +1,204 @@
+"""Inception-v3 with auxiliary head, as a Flax module.
+
+The reference's default backbone (train.py:122 'inceptionv3';
+nn/classifier.py:20-23): torchvision inception_v3 with ``AuxLogits.fc``
+replaced by a fresh Linear and the main ``fc`` replaced by the MLP head. In
+train mode it returns (features, aux_logits) and the driver applies
+``loss1 + 0.4 * loss2`` (train.py:48-52) — reproduced by
+tpuic.train.loss.classification_loss.
+
+Architecture follows Szegedy et al. 2015 (v3) exactly as torchvision builds
+it: stem (5 convs + 2 pools), 3×InceptionA, InceptionB, 4×InceptionC,
+InceptionD, 2×InceptionE, aux classifier branching after the InceptionC
+stack. All convs are BN convs (no bias, BN eps 1e-3). Input 299×299 (the
+reference resizes to 299, train.py:110).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpuic.models.layers import batch_norm
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = 0
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-3
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="conv")(x)
+        x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
+                       dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="bn")(x)
+        return nn.relu(x)
+
+
+def _avgpool3(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        b1 = C(64, (1, 1), name="b1x1")(x, train)
+        b5 = C(48, (1, 1), name="b5_1")(x, train)
+        b5 = C(64, (5, 5), padding=2, name="b5_2")(b5, train)
+        b3 = C(64, (1, 1), name="b3_1")(x, train)
+        b3 = C(96, (3, 3), padding=1, name="b3_2")(b3, train)
+        b3 = C(96, (3, 3), padding=1, name="b3_3")(b3, train)
+        bp = C(self.pool_features, (1, 1), name="bpool")(_avgpool3(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        b3 = C(384, (3, 3), strides=(2, 2), name="b3")(x, train)
+        bd = C(64, (1, 1), name="bd_1")(x, train)
+        bd = C(96, (3, 3), padding=1, name="bd_2")(bd, train)
+        bd = C(96, (3, 3), strides=(2, 2), name="bd_3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        c7 = self.channels_7x7
+        b1 = C(192, (1, 1), name="b1x1")(x, train)
+        b7 = C(c7, (1, 1), name="b7_1")(x, train)
+        b7 = C(c7, (1, 7), padding=((0, 0), (3, 3)), name="b7_2")(b7, train)
+        b7 = C(192, (7, 1), padding=((3, 3), (0, 0)), name="b7_3")(b7, train)
+        bd = C(c7, (1, 1), name="bd_1")(x, train)
+        bd = C(c7, (7, 1), padding=((3, 3), (0, 0)), name="bd_2")(bd, train)
+        bd = C(c7, (1, 7), padding=((0, 0), (3, 3)), name="bd_3")(bd, train)
+        bd = C(c7, (7, 1), padding=((3, 3), (0, 0)), name="bd_4")(bd, train)
+        bd = C(192, (1, 7), padding=((0, 0), (3, 3)), name="bd_5")(bd, train)
+        bp = C(192, (1, 1), name="bpool")(_avgpool3(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        b3 = C(192, (1, 1), name="b3_1")(x, train)
+        b3 = C(320, (3, 3), strides=(2, 2), name="b3_2")(b3, train)
+        b7 = C(192, (1, 1), name="b7_1")(x, train)
+        b7 = C(192, (1, 7), padding=((0, 0), (3, 3)), name="b7_2")(b7, train)
+        b7 = C(192, (7, 1), padding=((3, 3), (0, 0)), name="b7_3")(b7, train)
+        b7 = C(192, (3, 3), strides=(2, 2), name="b7_4")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        b1 = C(320, (1, 1), name="b1x1")(x, train)
+        b3 = C(384, (1, 1), name="b3_1")(x, train)
+        b3a = C(384, (1, 3), padding=((0, 0), (1, 1)), name="b3_2a")(b3, train)
+        b3b = C(384, (3, 1), padding=((1, 1), (0, 0)), name="b3_2b")(b3, train)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = C(448, (1, 1), name="bd_1")(x, train)
+        bd = C(384, (3, 3), padding=1, name="bd_2")(bd, train)
+        bda = C(384, (1, 3), padding=((0, 0), (1, 1)), name="bd_3a")(bd, train)
+        bdb = C(384, (3, 1), padding=((1, 1), (0, 0)), name="bd_3b")(bd, train)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        bp = C(192, (1, 1), name="bpool")(_avgpool3(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Aux classifier (torchvision InceptionAux): the reference swaps its fc
+    for Linear(768, num_classes) (nn/classifier.py:22-23)."""
+
+    num_classes: int
+    conv_kw: dict = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        C = partial(ConvBN, **self.conv_kw)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = C(128, (1, 1), name="conv0")(x, train)
+        x = C(768, (5, 5), name="conv1")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.conv_kw.get("param_dtype",
+                                                     jnp.float32),
+                        name="fc")(x.astype(jnp.float32))
+
+
+class InceptionV3(nn.Module):
+    """Returns features [B, 2048]; in train mode (features, aux_logits).
+
+    ``aux_classes`` sizes the aux head (the reference gives it num_classes).
+    """
+
+    aux_classes: int = 0  # 0 disables the aux branch
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-3
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False):
+        kw = dict(bn_momentum=self.bn_momentum, bn_eps=self.bn_eps,
+                  dtype=self.dtype, param_dtype=self.param_dtype)
+        C = partial(ConvBN, **kw)
+        x = x.astype(self.dtype)
+        x = C(32, (3, 3), strides=(2, 2), name="stem1")(x, train)
+        x = C(32, (3, 3), name="stem2")(x, train)
+        x = C(64, (3, 3), padding=1, name="stem3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = C(80, (1, 1), name="stem4")(x, train)
+        x = C(192, (3, 3), name="stem5")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, conv_kw=kw, name="mixed5b")(x, train)
+        x = InceptionA(64, conv_kw=kw, name="mixed5c")(x, train)
+        x = InceptionA(64, conv_kw=kw, name="mixed5d")(x, train)
+        x = InceptionB(conv_kw=kw, name="mixed6a")(x, train)
+        x = InceptionC(128, conv_kw=kw, name="mixed6b")(x, train)
+        x = InceptionC(160, conv_kw=kw, name="mixed6c")(x, train)
+        x = InceptionC(160, conv_kw=kw, name="mixed6d")(x, train)
+        x = InceptionC(192, conv_kw=kw, name="mixed6e")(x, train)
+        aux = None
+        if self.aux_classes and train:
+            aux = InceptionAux(self.aux_classes, conv_kw=kw,
+                               name="aux")(x, train)
+        x = InceptionD(conv_kw=kw, name="mixed7a")(x, train)
+        x = InceptionE(conv_kw=kw, name="mixed7b")(x, train)
+        x = InceptionE(conv_kw=kw, name="mixed7c")(x, train)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # [B, 2048]
+        if self.aux_classes and train:
+            return x, aux
+        return x

@@ -1,0 +1,136 @@
+"""Vision Transformer (ViT-B/16 and friends) as Flax modules.
+
+BASELINE.md parity config 4: 'ViT-B/16 / ImageNet (attention path, exercises
+XLA SPMD)'. The reference has no attention model; this is the build's
+attention-bearing backbone, designed mesh-aware from the start:
+
+- Attention and MLP dense kernels carry flax logical-axis partitioning
+  metadata (('embed','model') on up-projections, ('model','embed') on
+  down-projections), so tensor parallelism over the mesh's ``model`` axis is
+  Megatron-style: QKV/up sharded on heads/hidden, out/down sharded on the
+  input dim, with XLA inserting the psum on the second contraction.
+- Sequence length for 224² at patch 16 is a fixed 197 tokens (SURVEY.md §5:
+  no ring/context parallelism needed at this scale; the token axis is simply
+  a named dim a future ``seq`` mesh axis can shard).
+- bfloat16 activations; attention softmax in float32 for stability.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def _dense(features, name, dtype, param_dtype, logical):
+    return nn.Dense(
+        features, dtype=dtype, param_dtype=param_dtype, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), logical),
+    )
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        qkv = _dense(3 * d, "qkv", self.dtype, self.param_dtype,
+                     ("embed", "model"))(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[0], out.shape[1], d)
+        return _dense(d, "out", self.dtype, self.param_dtype,
+                      ("model", "embed"))(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln1")(x)
+        y = MultiHeadAttention(self.num_heads, self.dtype, self.param_dtype,
+                               name="attn")(y, deterministic)
+        if self.dropout:
+            y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln2")(x)
+        y = _dense(d * self.mlp_ratio, "mlp_up", self.dtype, self.param_dtype,
+                   ("embed", "model"))(y)
+        y = nn.gelu(y)
+        y = _dense(d, "mlp_down", self.dtype, self.param_dtype,
+                   ("model", "embed"))(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class ViT(nn.Module):
+    """Returns the CLS-token feature [B, hidden]."""
+
+    patch: int = 16
+    hidden: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        B = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="patch_embed")(x)
+        x = x.reshape(B, -1, self.hidden)  # [B, N, D]
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.hidden), self.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.hidden)
+                                              ).astype(self.dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.hidden), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
+                             self.dtype, self.param_dtype,
+                             name=f"block{i}")(x, deterministic=not train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_final")(x)
+        return x[:, 0].astype(jnp.float32)
+
+
+def vit_b16(**kw) -> ViT:
+    return ViT(patch=16, hidden=768, depth=12, num_heads=12, **kw)
+
+
+def vit_s16(**kw) -> ViT:
+    return ViT(patch=16, hidden=384, depth=12, num_heads=6, **kw)
+
+
+def vit_tiny(**kw) -> ViT:
+    """Test-scale ViT (fast CI)."""
+    return ViT(patch=4, hidden=64, depth=2, num_heads=4, **kw)

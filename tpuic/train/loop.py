@@ -77,7 +77,8 @@ class Trainer:
             self.state = create_train_state(
                 self.model, tx, jax.random.key(cfg.run.seed), shape)
         self.train_step = make_train_step(cfg.optim, mcfg, step_mesh,
-                                          lr_schedule=self.schedule)
+                                          lr_schedule=self.schedule,
+                                          seed=cfg.run.seed)
         self.eval_step = make_eval_step(cfg.optim, mcfg, step_mesh)
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)

@@ -41,7 +41,11 @@ def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
     global-batch-sized unsharded dummy would OOM device 0 at pod scale.
     """
     dummy = jnp.zeros((1,) + tuple(input_shape[1:]), jnp.float32)
-    variables = model.init(rng, dummy, train=False)
+    # Init in train mode so branches that only exist then (inception aux head,
+    # drop-path) create their params too; eval-only applies just ignore them.
+    params_rng, dropout_rng = jax.random.split(rng)
+    variables = model.init({"params": params_rng, "dropout": dropout_rng},
+                           dummy, train=True)
     params = variables.get("params", FrozenDict())
     batch_stats = variables.get("batch_stats", FrozenDict())
     return TrainState(

@@ -49,7 +49,7 @@ def _replicated(mesh: Mesh):
 def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                     mesh: Optional[Mesh] = None,
                     lr_schedule: Optional[optax.Schedule] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, seed: int = 0) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
     batch: {'image': [B,H,W,3] f32, 'label': [B] i32, 'mask': [B] f32}.
@@ -65,10 +65,14 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         images, labels = batch["image"], batch["label"]
         mask = batch.get("mask")
 
+        # Per-step dropout/drop-path randomness, deterministic in (seed, step).
+        dropout_rng = jax.random.fold_in(jax.random.key(seed), state.step)
+
         def loss_fn(params):
             variables = {"params": params, "batch_stats": state.batch_stats}
             out, mutated = state.apply_fn(variables, images, train=True,
-                                          mutable=["batch_stats"])
+                                          mutable=["batch_stats"],
+                                          rngs={"dropout": dropout_rng})
             loss = classification_loss(out, labels, class_weights=class_weights,
                                        mask=mask, aux_weight=aux_w,
                                        label_smoothing=smoothing)
