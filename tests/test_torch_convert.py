@@ -1,0 +1,237 @@
+"""Torch -> Flax converter: numerical forward parity.
+
+Builds a torch ResNet-18 with torchvision's exact module naming (torchvision
+itself is not installed; the reference selects its backbones from torchvision,
+nn/classifier.py:11-15), attaches the reference's MLP head
+(nn/classifier.py:26-34, Sequential indices fc.0/2/4/6), converts the randomly
+initialized state_dict with ``convert_resnet``, and asserts the Flax model
+produces the same logits in eval mode.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpuic.checkpoint.manager import lenient_restore  # noqa: E402
+from tpuic.checkpoint.torch_convert import (  # noqa: E402
+    convert_resnet, strip_prefixes)
+from tpuic.models import create_model  # noqa: E402
+
+
+class TorchBasicBlock(tnn.Module):
+    def __init__(self, inp, out, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inp, out, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(out)
+        self.conv2 = tnn.Conv2d(out, out, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(out)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = None
+        if stride != 1 or inp != out:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(inp, out, 1, stride, bias=False),
+                tnn.BatchNorm2d(out))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + idt)
+
+
+class TorchResNet18(tnn.Module):
+    """torchvision-named resnet18 + the reference's MLP fc head."""
+
+    def __init__(self, num_classes=7):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU(inplace=True)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        widths, sizes = (64, 128, 256, 512), (2, 2, 2, 2)
+        inp = 64
+        for s, (w, n) in enumerate(zip(widths, sizes), start=1):
+            blocks = []
+            for i in range(n):
+                stride = 2 if s > 1 and i == 0 else 1
+                blocks.append(TorchBasicBlock(inp, w, stride))
+                inp = w
+            setattr(self, f"layer{s}", tnn.Sequential(*blocks))
+        # reference head: in->128->64->32->n with ReLU (nn/classifier.py:26-34)
+        self.fc = tnn.Sequential(
+            tnn.Linear(512, 128), tnn.ReLU(),
+            tnn.Linear(128, 64), tnn.ReLU(),
+            tnn.Linear(64, 32), tnn.ReLU(),
+            tnn.Linear(32, num_classes))
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for s in (1, 2, 3, 4):
+            x = getattr(self, f"layer{s}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    model = TorchResNet18(num_classes=7).eval()
+    # make running stats non-trivial so eval-mode BN is actually exercised
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 1.5)
+    return model
+
+
+def test_forward_parity(torch_model):
+    x = np.random.default_rng(1).normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+
+    tree = convert_resnet(torch_model.state_dict())
+    model = create_model("resnet18", 7, dtype="float32")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)),
+                           train=False)
+    merged_p, n_loaded, n_total = lenient_restore(
+        dict(variables["params"]), tree["params"])
+    assert n_loaded == n_total, f"only {n_loaded}/{n_total} params mapped"
+    merged_s, n_s, n_s_total = lenient_restore(
+        dict(variables["batch_stats"]), tree["batch_stats"])
+    assert n_s == n_s_total
+
+    got = model.apply({"params": merged_p, "batch_stats": merged_s},
+                      jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_module_and_encoder_prefixes_stripped(torch_model):
+    sd = {f"module.encoder.{k}": v for k, v in
+          torch_model.state_dict().items()}
+    flat = strip_prefixes(sd)
+    assert "conv1.weight" in flat
+    tree = convert_resnet(sd)
+    assert "conv1" in tree["params"]["backbone"]
+    assert "mean" in tree["batch_stats"]["backbone"]["bn1"]
+
+
+def test_unknown_keys_skipped(torch_model):
+    sd = dict(torch_model.state_dict())
+    sd["totally.unknown.weight"] = torch.zeros(3)
+    tree = convert_resnet(sd)  # must not raise
+    assert "totally" not in tree["params"]
+
+
+def test_plain_torchvision_fc_maps_to_out():
+    sd = {"fc.weight": torch.zeros(7, 512), "fc.bias": torch.zeros(7)}
+    tree = convert_resnet(sd)
+    assert tree["params"]["head"]["out"]["kernel"].shape == (512, 7)
+
+
+class TorchBottleneck(tnn.Module):
+    def __init__(self, inp, width, stride=1):
+        super().__init__()
+        out = width * 4
+        self.conv1 = tnn.Conv2d(inp, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, out, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(out)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = None
+        if stride != 1 or inp != out:
+            # torchvision's layer1.0 uses this stride-1 channel-expanding form
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(inp, out, 1, stride, bias=False),
+                tnn.BatchNorm2d(out))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + idt)
+
+
+class TorchResNet50(tnn.Module):
+    def __init__(self, num_classes=7):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU(inplace=True)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        widths, sizes = (64, 128, 256, 512), (3, 4, 6, 3)
+        inp = 64
+        for s, (w, n) in enumerate(zip(widths, sizes), start=1):
+            blocks = []
+            for i in range(n):
+                stride = 2 if s > 1 and i == 0 else 1
+                blocks.append(TorchBottleneck(inp, w, stride))
+                inp = w * 4
+            setattr(self, f"layer{s}", tnn.Sequential(*blocks))
+        self.fc = tnn.Sequential(
+            tnn.Linear(2048, 128), tnn.ReLU(),
+            tnn.Linear(128, 64), tnn.ReLU(),
+            tnn.Linear(64, 32), tnn.ReLU(),
+            tnn.Linear(32, num_classes))
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for s in (1, 2, 3, 4):
+            x = getattr(self, f"layer{s}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def test_bottleneck_forward_parity():
+    torch.manual_seed(2)
+    tm = TorchResNet50(num_classes=7).eval()
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 1.5)
+    x = np.random.default_rng(3).normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+
+    tree = convert_resnet(tm.state_dict())
+    model = create_model("resnet50", 7, dtype="float32")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)),
+                           train=False)
+    merged_p, n_loaded, n_total = lenient_restore(
+        dict(variables["params"]), tree["params"])
+    assert n_loaded == n_total, f"only {n_loaded}/{n_total} params mapped"
+    merged_s, n_s, n_s_total = lenient_restore(
+        dict(variables["batch_stats"]), tree["batch_stats"])
+    assert n_s == n_s_total
+
+    got = model.apply({"params": merged_p, "batch_stats": merged_s},
+                      jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
+
+
+def test_reference_checkpoint_file_roundtrip(torch_model, tmp_path):
+    from tpuic.checkpoint.torch_convert import convert_reference_checkpoint
+
+    path = str(tmp_path / "best_model")
+    sd = {f"module.encoder.{k}": v for k, v in torch_model.state_dict().items()}
+    torch.save({"epoch": 42, "best_score": 87.5, "state_dict": sd}, path)
+    tree = convert_reference_checkpoint(path)
+    assert tree["epoch"] == 42 and tree["best_score"] == 87.5
+    assert "conv1" in tree["params"]["backbone"]
+
+    # bare state_dict file (no wrapper) also loads
+    bare = str(tmp_path / "bare.pth")
+    torch.save(torch_model.state_dict(), bare)
+    tree2 = convert_reference_checkpoint(bare)
+    assert tree2["epoch"] == 0
+    assert "mean" in tree2["batch_stats"]["backbone"]["bn1"]

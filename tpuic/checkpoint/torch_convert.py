@@ -1,0 +1,174 @@
+"""Torch state_dict -> Flax pytree checkpoint converter.
+
+The reference saves ``{'epoch', 'best_score', 'state_dict'}`` with DDP's
+``module.`` prefix (train.py:177-179), where the model is
+``Classifier(name, num_classes)`` — a torchvision backbone whose ``fc`` was
+replaced by a 4-layer MLP (``fc.0/2/4/6`` Linear indices of the Sequential at
+nn/classifier.py:26-34), all hung off an ``encoder`` attribute
+(nn/classifier.py:11-27). This module converts those checkpoints — or plain
+torchvision ``resnet{18,34,50,101}`` state_dicts — into this framework's
+``{'params': ..., 'batch_stats': ...}`` trees so pretrained-weight parity can
+be verified (SURVEY.md §7 "Checkpoint compatibility").
+
+Layout translation rules (torch -> flax):
+
+- conv weight  OIHW -> HWIO  (transpose 2,3,1,0)
+- linear weight (out,in) -> kernel (in,out)  (transpose)
+- BatchNorm  weight/bias/running_mean/running_var ->
+  scale/bias (params) + mean/var (batch_stats); num_batches_tracked dropped.
+
+Name translation (torchvision resnet -> tpuic ResNet, see models/resnet.py):
+
+- ``conv1``/``bn1`` stem keep their names
+- ``layer{s}.{i}.<leaf>`` -> ``layer{s}_{i}/<leaf>``
+- ``layer{s}.{i}.downsample.0`` -> ``downsample_conv``; ``.downsample.1`` ->
+  ``downsample_bn``
+- ``fc.0/2/4/6`` (the reference's MLP head) -> ``head/fc0,fc1,fc2,out``;
+  a plain torchvision ``fc`` (single Linear) -> ``head/out`` when shapes fit.
+
+The output trees are plain nested dicts compatible with
+``tpuic.checkpoint.manager.lenient_restore`` — unmapped or shape-mismatched
+leaves are simply absent and fall back to the fresh initialization, matching
+the reference's lenient partial load (train.py:143-148).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _set(tree: Dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    d = tree
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def strip_prefixes(state_dict: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """Drop DDP's ``module.`` and the reference's ``encoder.`` wrappers."""
+    out = {}
+    for k, v in state_dict.items():
+        for pre in ("module.", "encoder."):
+            if k.startswith(pre):
+                k = k[len(pre):]
+        out[k] = np.asarray(v.detach().cpu().numpy()
+                            if hasattr(v, "detach") else v)
+    return out
+
+
+def _conv(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def _linear(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w)  # (out, in) -> (in, out)
+
+
+# torchvision resnet leaf name within a block -> tpuic module name
+_RESNET_LEAF = {
+    "conv1": "conv1", "conv2": "conv2", "conv3": "conv3",
+    "bn1": "bn1", "bn2": "bn2", "bn3": "bn3",
+    "downsample.0": "downsample_conv", "downsample.1": "downsample_bn",
+}
+
+# the reference head's Sequential Linear indices (nn/classifier.py:26-34)
+_HEAD_FC = {"0": "fc0", "2": "fc1", "4": "fc2", "6": "out"}
+
+_BLOCK_RE = re.compile(r"^layer(\d+)\.(\d+)\.(.+)$")
+
+
+def convert_resnet(state_dict: Mapping[str, Any],
+                   backbone_scope: str = "backbone",
+                   head_scope: str = "head") -> Dict[str, Dict]:
+    """Convert a torchvision-style resnet (or reference Classifier-over-resnet)
+    state_dict into ``{'params': ..., 'batch_stats': ...}`` nested dicts.
+
+    Unknown keys are skipped (collected in the returned tree under no path);
+    use ``lenient_restore`` to merge into a live model state.
+    """
+    sd = strip_prefixes(state_dict)
+    params: Dict = {}
+    stats: Dict = {}
+
+    def put_bn(scope: Tuple[str, ...], leaf: str, v: np.ndarray) -> None:
+        if leaf == "weight":
+            _set(params, scope + ("scale",), v)
+        elif leaf == "bias":
+            _set(params, scope + ("bias",), v)
+        elif leaf == "running_mean":
+            _set(stats, scope + ("mean",), v)
+        elif leaf == "running_var":
+            _set(stats, scope + ("var",), v)
+        # num_batches_tracked intentionally dropped
+
+    for key, v in sd.items():
+        parts = key.rsplit(".", 1)
+        if len(parts) != 2:
+            continue
+        name, leaf = parts
+
+        # -- stem ------------------------------------------------------------
+        if name == "conv1" and leaf == "weight":
+            _set(params, (backbone_scope, "conv1", "kernel"), _conv(v))
+            continue
+        if name == "bn1":
+            put_bn((backbone_scope, "bn1"), leaf, v)
+            continue
+
+        # -- stages ----------------------------------------------------------
+        m = _BLOCK_RE.match(name)
+        if m:
+            stage, block, inner = m.group(1), m.group(2), m.group(3)
+            mod = _RESNET_LEAF.get(inner)
+            if mod is None:
+                continue
+            scope = (backbone_scope, f"layer{stage}_{block}", mod)
+            if mod.startswith("conv") or mod == "downsample_conv":
+                if leaf == "weight":
+                    _set(params, scope + ("kernel",), _conv(v))
+            else:
+                put_bn(scope, leaf, v)
+            continue
+
+        # -- head ------------------------------------------------------------
+        if name.startswith("fc"):
+            rest = name[2:].lstrip(".")
+            target = _HEAD_FC.get(rest) if rest else "out"
+            if target is None:
+                continue
+            if leaf == "weight":
+                _set(params, (head_scope, target, "kernel"), _linear(v))
+            elif leaf == "bias":
+                _set(params, (head_scope, target, "bias"), v)
+            continue
+
+    return {"params": params, "batch_stats": stats}
+
+
+def load_reference_checkpoint(path: str) -> Dict[str, Any]:
+    """Load a reference ``torch.save`` checkpoint file (train.py:177-179).
+
+    Returns ``{'epoch': int, 'best_score': float, 'state_dict': {...}}``; a
+    bare state_dict file is wrapped with epoch=0/best_score=0.0.
+    """
+    import torch  # deferred: torch is only needed on the conversion path
+
+    # weights_only: the payload is tensors + scalars; never unpickle code.
+    payload = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(payload, dict) and "state_dict" in payload:
+        return {"epoch": int(payload.get("epoch", 0)),
+                "best_score": float(payload.get("best_score", 0.0)),
+                "state_dict": payload["state_dict"]}
+    return {"epoch": 0, "best_score": 0.0, "state_dict": payload}
+
+
+def convert_reference_checkpoint(path: str) -> Dict[str, Any]:
+    """File -> ``{'params', 'batch_stats', 'epoch', 'best_score'}``."""
+    payload = load_reference_checkpoint(path)
+    tree = convert_resnet(payload["state_dict"])
+    tree["epoch"] = payload["epoch"]
+    tree["best_score"] = payload["best_score"]
+    return tree
