@@ -68,6 +68,10 @@ class ModelConfig:
     bn_eps: float = 1e-5
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
+    # Attention implementation for attention-bearing backbones (ViT):
+    # 'dense' (einsum softmax) or 'flash' (Pallas blockwise online-softmax,
+    # tpuic/kernels/flash_attention.py). CNNs ignore this.
+    attention: str = "dense"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +94,9 @@ class OptimConfig:
     warmup_epochs: int = 0
     grad_clip_norm: float = 0.0
     label_smoothing: float = 0.0
+    # Use the fused Pallas cross-entropy kernel
+    # (tpuic/kernels/cross_entropy.py) in the train step.
+    fused_loss: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7 step 5).
+
+Kernels compile to Mosaic on TPU; on CPU (CI, the 8-device mesh tests) they
+run in Pallas interpret mode so the same kernel logic is exercised everywhere.
+"""
+
+from tpuic.kernels.cross_entropy import fused_weighted_cross_entropy  # noqa: F401
+from tpuic.kernels.flash_attention import flash_attention  # noqa: F401
+
+
+def default_interpret() -> bool:
+    """Interpret mode on anything that is not a real TPU backend."""
+    import jax
+
+    return jax.default_backend() not in ("tpu",)

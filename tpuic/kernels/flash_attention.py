@@ -1,0 +1,176 @@
+"""Flash attention (blockwise online-softmax) as a Pallas TPU kernel.
+
+The reference has no attention op at all (SURVEY.md §2c: vision CNNs only);
+attention enters this framework through the ViT backbone (BASELINE.md config
+4) and the sequence-parallel path (tpuic/parallel/ring_attention.py). This
+kernel is the per-device block primitive: the forward never materializes the
+[N, N] probability matrix in HBM — only [block_q, block_k] tiles in VMEM —
+and contractions are MXU-shaped with a float32 online softmax carried across
+key blocks.
+
+Backward is recompute-based (jax.custom_vjp): probabilities are rebuilt by
+differentiating a dense float32-softmax form that matches the forward
+kernel's numerics. This means the *backward* pass does materialize O(N²)
+attention scores (standard dense memory); the flash memory win currently
+applies to inference and to the forward residuals (q, k, v only — no saved
+probabilities). A blockwise Pallas backward is the planned upgrade.
+
+Sharding: a Pallas call is an opaque custom call — GSPMD/Shardy cannot
+partition it and would all-gather batch-sharded operands onto every device.
+Pass ``mesh`` (with a ``data`` axis) and the wrapper runs the kernel inside
+``jax.shard_map`` over the batch axis, keeping the computation fully
+batch-parallel; attention itself is per-sample so no collectives are needed.
+
+Layout: [B, N, H, D] ("bqhd", matching models/vit.py einsums). N is padded to
+the key-block size with masked (-inf) keys, so callers can pass any length
+(ViT's 197 tokens included).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                valid_len: int):
+    """One (batch*head, q-block) program: online softmax over key blocks."""
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    bq = q.shape[0]
+    n_padded = k_ref.shape[1]
+    d = q.shape[-1]
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    for j in range(n_padded // block_k):
+        kj = k_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
+        vj = v_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(kpos < valid_len, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, vj,
+                                    preferred_element_type=jnp.float32)
+        m = m_new
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
+    pad = to - t.shape[1]
+    if pad == 0:
+        return t
+    return jnp.pad(t, ((0, 0), (0, pad), (0, 0)))
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool):
+    """q,k,v: [B, N, H, D] -> out [B, N, H, D]. Single-device (or per-shard)."""
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_pad_q = -(-n // block_q) * block_q
+    n_pad_k = -(-n // block_k) * block_k
+    n_padded = max(n_pad_q, n_pad_k)
+
+    def fold(t):  # [B,N,H,D] -> [B*H, N_padded, D]
+        t = jnp.transpose(t, (0, 2, 1, 3)).reshape(b * h, n, d)
+        return _pad_seq(t, n_padded)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (b * h, n_padded // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                          valid_len=n),
+        out_shape=jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * n_padded * n_padded * d,
+            bytes_accessed=3 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(qf, kf, vf)
+    out = out[:, :n].reshape(b, h, n, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _dense_attention_f32(q, k, v):
+    """Dense reference with the same numerics as the kernel: f32 scores, f32
+    softmax, f32 p·v contraction, cast to input dtype at the end. Used for the
+    recompute backward so the gradient is of the function the forward computed."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (1.0 / (d ** 0.5))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _shard_batch(mesh: Optional[Mesh], b: int) -> bool:
+    """True when the kernel should run under shard_map over the data axis."""
+    if mesh is None or "data" not in mesh.axis_names:
+        return False
+    n_data = mesh.shape["data"]
+    return n_data > 1 and b % n_data == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None,
+                    mesh: Optional[Mesh] = None):
+    """Softmax attention, [B, N, H, D] in/out, no causal mask (ViT is
+    bidirectional). ``interpret=None`` auto-selects interpret mode off-TPU;
+    ``mesh`` keeps the kernel batch-parallel under a sharded jit (see module
+    docstring)."""
+    if interpret is None:
+        from tpuic.kernels import default_interpret
+        interpret = default_interpret()
+    if _shard_batch(mesh, q.shape[0]):
+        spec = P("data")
+        return jax.shard_map(
+            lambda a, b_, c: _flash_fwd(a, b_, c, block_q, block_k, interpret),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,  # pallas out_shapes carry no vma annotations
+        )(q, k, v)
+    return _flash_fwd(q, k, v, block_q, block_k, interpret)
+
+
+def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh):
+    out = flash_attention(q, k, v, block_q, block_k, interpret, mesh)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(block_q, block_k, interpret, mesh, res, g):
+    q, k, v = res
+    # Recompute-based backward (see module docstring): plain jnp ops, which
+    # GSPMD shards over the batch axis natively — no shard_map needed.
+    _, pullback = jax.vjp(_dense_attention_f32, q, k, v)
+    return pullback(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -34,39 +34,50 @@ def available_models():
     return sorted(_REGISTRY)
 
 
+ATTENTION_IMPLS = ("dense", "flash")
+
+
 def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     param_dtype=jnp.float32, bn_momentum: float = 0.9,
-                    bn_eps: float = 1e-5):
+                    bn_eps: float = 1e-5, attention: str = "dense",
+                    mesh=None):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
+    if attention not in ATTENTION_IMPLS:
+        raise ValueError(f"unknown attention impl '{attention}'; "
+                         f"available: {ATTENTION_IMPLS}")
     factory, has_aux = _REGISTRY[name]
     return factory(num_classes=num_classes, dtype=dtype,
                    param_dtype=param_dtype, bn_momentum=bn_momentum,
-                   bn_eps=bn_eps), has_aux
+                   bn_eps=bn_eps, attention=attention, mesh=mesh), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  dtype="bfloat16", param_dtype="float32",
-                 bn_momentum: float = 0.9, bn_eps: float = 1e-5) -> Classifier:
+                 bn_momentum: float = 0.9, bn_eps: float = 1e-5,
+                 attention: str = "dense", mesh=None) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
-                                        bn_momentum=bn_momentum, bn_eps=bn_eps)
+                                        bn_momentum=bn_momentum, bn_eps=bn_eps,
+                                        attention=attention, mesh=mesh)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
 
 
-def create_model_from_config(cfg: ModelConfig) -> Classifier:
+def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
     return create_model(cfg.name, cfg.num_classes, head_widths=cfg.head_widths,
                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                        bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps)
+                        bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
+                        attention=cfg.attention, mesh=mesh)
 
 
 def _register_builtins():
     def _rn(factory, **extra):
-        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
-            del num_classes
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
+                 attention, mesh):
+            del num_classes, attention, mesh
             return factory(dtype=dtype, param_dtype=param_dtype,
                            bn_momentum=bn_momentum, bn_eps=bn_eps, **extra)
         return make
@@ -78,8 +89,9 @@ def _register_builtins():
     register("resnet18-cifar", _rn(_resnet.resnet18, small_stem=True))
 
     def _eff(variant):
-        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
-            del num_classes, bn_eps  # torch effnet uses eps 1e-3 (module default)
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
+                 attention, mesh):
+            del num_classes, bn_eps, attention, mesh  # torch effnet: eps 1e-3
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -89,17 +101,20 @@ def _register_builtins():
         register(f"efficientnet-{v}", _eff(v))
 
     def _vit_factory(ctor):
-        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
+        def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
+                 attention, mesh):
             del num_classes, bn_momentum, bn_eps  # no BN in ViT
-            return ctor(dtype=dtype, param_dtype=param_dtype)
+            return ctor(dtype=dtype, param_dtype=param_dtype,
+                        attention=attention, mesh=mesh)
         return make
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
     register("vit-s16", _vit_factory(_vit.vit_s16))
     register("vit-tiny", _vit_factory(_vit.vit_tiny))
 
-    def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps):
-        del bn_eps  # torch inception uses eps 1e-3 (module default)
+    def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
+             attention, mesh):
+        del bn_eps, attention, mesh  # torch inception: eps 1e-3 (module default)
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

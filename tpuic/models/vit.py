@@ -32,13 +32,26 @@ def _dense(features, name, dtype, param_dtype, logical):
     )
 
 
+ATTENTION_IMPLS = ("dense", "flash")
+
+
 class MultiHeadAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    # 'dense': einsum + f32 softmax. 'flash': Pallas blockwise online-softmax
+    # kernel (tpuic/kernels/flash_attention.py) — forward never materializes
+    # the [N,N] probability matrix; backward is dense recompute (see kernel).
+    attention: str = "dense"
+    # Device mesh: keeps the flash kernel batch-parallel under a sharded jit
+    # (shard_map over the 'data' axis); None => single-device pallas_call.
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+        if self.attention not in ATTENTION_IMPLS:
+            raise ValueError(f"unknown attention impl '{self.attention}'; "
+                             f"available: {ATTENTION_IMPLS}")
         d = x.shape[-1]
         head_dim = d // self.num_heads
         qkv = _dense(3 * d, "qkv", self.dtype, self.param_dtype,
@@ -49,10 +62,15 @@ class MultiHeadAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if self.attention == "flash":
+            from tpuic.kernels import flash_attention
+            out = flash_attention(q, k, v, 128, 128, None, self.mesh)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            probs = nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(out.shape[0], out.shape[1], d)
         return _dense(d, "out", self.dtype, self.param_dtype,
                       ("model", "embed"))(out)
@@ -64,6 +82,8 @@ class EncoderBlock(nn.Module):
     dropout: float = 0.0
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    attention: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -71,6 +91,7 @@ class EncoderBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
         y = MultiHeadAttention(self.num_heads, self.dtype, self.param_dtype,
+                               self.attention, self.mesh,
                                name="attn")(y, deterministic)
         if self.dropout:
             y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
@@ -98,6 +119,8 @@ class ViT(nn.Module):
     dropout: float = 0.0
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    attention: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -116,7 +139,8 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         for i in range(self.depth):
             x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
-                             self.dtype, self.param_dtype,
+                             self.dtype, self.param_dtype, self.attention,
+                             self.mesh,
                              name=f"block{i}")(x, deterministic=not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)

@@ -68,7 +68,7 @@ class Trainer:
         if num_classes != mcfg.num_classes:
             import dataclasses
             mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
-        self.model = create_model_from_config(mcfg)
+        self.model = create_model_from_config(mcfg, mesh=self.mesh)
         steps = max(1, self.train_loader.steps_per_epoch())
         self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs)
         tx = make_optimizer(cfg.optim, steps, cfg.run.epochs)

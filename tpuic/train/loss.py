@@ -50,20 +50,35 @@ def weighted_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     return jnp.sum(w * nll) / jnp.maximum(jnp.sum(w), 1e-12)
 
 
+LOSS_IMPLS = ("reference", "fused")
+
+
 def classification_loss(outputs, labels, *, class_weights=None, mask=None,
                         aux_weight: float = 0.4,
-                        label_smoothing: float = 0.0) -> jnp.ndarray:
+                        label_smoothing: float = 0.0,
+                        impl: str = "reference", mesh=None) -> jnp.ndarray:
     """Main loss, plus the inception aux term when outputs is a tuple.
 
     Reference train.py:48-56: ``loss = loss_fn(out1,l) + 0.4*loss_fn(out2,l)``
-    in train mode, plain CE otherwise.
+    in train mode, plain CE otherwise. ``impl='fused'`` routes through the
+    Pallas kernel (tpuic/kernels/cross_entropy.py), same numerics; pass
+    ``mesh`` so the kernel stays batch-parallel under a sharded jit.
     """
+    if impl not in LOSS_IMPLS:
+        raise ValueError(f"unknown loss impl '{impl}'; available: {LOSS_IMPLS}")
+    if impl == "fused":
+        from tpuic.kernels import fused_weighted_cross_entropy
+
+        def ce(logits):
+            return fused_weighted_cross_entropy(logits, labels, class_weights,
+                                                mask, label_smoothing, 128,
+                                                None, mesh)
+    else:
+        def ce(logits):
+            return weighted_cross_entropy(logits, labels, class_weights, mask,
+                                          label_smoothing)
+
     if isinstance(outputs, tuple):
         logits, aux_logits = outputs
-        main = weighted_cross_entropy(logits, labels, class_weights, mask,
-                                      label_smoothing)
-        aux = weighted_cross_entropy(aux_logits, labels, class_weights, mask,
-                                     label_smoothing)
-        return main + aux_weight * aux
-    return weighted_cross_entropy(outputs, labels, class_weights, mask,
-                                  label_smoothing)
+        return ce(logits) + aux_weight * ce(aux_logits)
+    return ce(outputs)

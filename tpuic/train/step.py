@@ -75,7 +75,9 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                                           rngs={"dropout": dropout_rng})
             loss = classification_loss(out, labels, class_weights=class_weights,
                                        mask=mask, aux_weight=aux_w,
-                                       label_smoothing=smoothing)
+                                       label_smoothing=smoothing,
+                                       impl="fused" if optim_cfg.fused_loss
+                                       else "reference", mesh=mesh)
             logits = out[0] if isinstance(out, tuple) else out
             return loss, (mutated.get("batch_stats", state.batch_stats), logits)
 
