@@ -47,6 +47,43 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    def test_gradients_match_dense_sharded(self, devices8):
+        """Backward kernels under shard_map over the data axis."""
+        from tpuic.config import MeshConfig
+        from tpuic.runtime.mesh import make_mesh
+
+        mesh = make_mesh(MeshConfig(data=8), devices8)
+        b, n, h, d = 8, 12, 2, 8
+        q, k, v = (_rand(i + 20, (b, n, h, d)) for i in range(3))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, 8, 8, None, mesh) ** 2)
+
+        def loss_dense(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_backward_residuals_are_linear_in_n(self):
+        """The saved residuals must be O(N·D) — (q, k, v, o, lse), never an
+        [N, N] probability matrix (the point of the flash backward)."""
+        b, n, h, d = 1, 64, 1, 8
+        q, k, v = (_rand(i, (b, n, h, d)) for i in range(3))
+        _, vjp_fn = jax.vjp(
+            lambda a, b_, c: flash_attention(a, b_, c, 8, 8), q, k, v)
+        leaves = jax.tree_util.tree_leaves(vjp_fn)
+        assert leaves, "no residuals found"
+        biggest = max(x.size for x in leaves if hasattr(x, "size"))
+        assert biggest <= b * n * h * d, (
+            f"residual of {biggest} elements suggests an O(N^2) save")
+
     def test_bf16_stays_finite(self):
         b, n, h, d = 1, 16, 2, 8
         q, k, v = (20.0 * _rand(i, (b, n, h, d)).astype(jnp.bfloat16)

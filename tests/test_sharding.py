@@ -1,0 +1,113 @@
+"""TP/FSDP state sharding (tpuic/parallel/sharding.py) on the 8-device mesh.
+
+The reference replicates params and Adam state on every rank (train.py:127-128);
+sharded training is this framework's extension — numerics must match the
+replicated path exactly (same global batch, same reductions).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuic.config import MeshConfig, ModelConfig, OptimConfig
+from tpuic.data.synthetic import synthetic_batch
+from tpuic.models import create_model
+from tpuic.parallel.sharding import (shard_state, state_partition_specs,
+                                     state_shardings)
+from tpuic.runtime.mesh import make_mesh
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_train_step
+
+
+def _make(name, mesh, batch=8, size=16, dtype="float32"):
+    mcfg = ModelConfig(name=name, num_classes=7, dtype=dtype)
+    ocfg = OptimConfig()
+    model = create_model(name, 7, dtype=dtype, mesh=mesh)
+    with mesh:
+        state = create_train_state(model, make_optimizer(ocfg),
+                                   jax.random.key(0), (batch, size, size, 3))
+    return mcfg, ocfg, state
+
+
+class TestPartitionSpecs:
+    def test_vit_tp_specs_follow_logical_axes(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, model=4), devices8)
+        _, _, state = _make("vit-tiny", mesh)
+        specs = state_partition_specs(state, mesh, tp=True, fsdp=False)
+        qkv = specs.params["backbone"]["block0"]["attn"]["qkv"]["kernel"]
+        out = specs.params["backbone"]["block0"]["attn"]["out"]["kernel"]
+        assert qkv == P(None, "model")
+        assert out == P("model", None)
+
+    def test_fsdp_shards_large_params_only(self, devices8):
+        mesh = make_mesh(MeshConfig(data=8), devices8)
+        _, _, state = _make("resnet18", mesh)
+        specs = state_partition_specs(state, mesh, tp=False, fsdp=True,
+                                      min_fsdp_size=2 ** 12)
+        flat = jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        sharded = [s for _, s in flat if s != P()]
+        assert sharded, "no FSDP-sharded leaves"
+        # biases / BN scales stay replicated
+        bn = specs.params["backbone"]["bn1"]["scale"]
+        assert bn == P()
+
+    def test_indivisible_dims_stay_replicated(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, model=4), devices8)
+        _, _, state = _make("vit-tiny", mesh)
+        # vit-tiny hidden=64; a head-dim that didn't divide by 4 would be
+        # dropped rather than crash — verified via a synthetic odd-shape leaf.
+        from flax.linen import spmd
+        leaf = spmd.LogicallyPartitioned(
+            jnp.zeros((7, 64)), names=("embed", "model"),
+            mesh=None, rules=None)
+        spec = state_partition_specs({"x": leaf}, mesh, tp=True, fsdp=True)
+        assert spec["x"] == P(None, "model")  # 7 % 2 != 0 -> embed dropped
+
+
+class TestShardedStepNumerics:
+    def test_fsdp_matches_replicated(self, devices8):
+        mesh = make_mesh(MeshConfig(data=8), devices8)
+        mcfg, ocfg, state = _make("resnet18", mesh)
+        batch = synthetic_batch(8, 16, 7)
+        bsh = NamedSharding(mesh, P("data"))
+        batch = {k: jax.device_put(v, bsh) for k, v in batch.items()}
+
+        repl_step = make_train_step(ocfg, mcfg, mesh, donate=False)
+        _, m_repl = repl_step(state, batch)
+
+        sh = state_shardings(state, mesh, tp=False, fsdp=True)
+        sstate = shard_state(state, sh)
+        fsdp_step = make_train_step(ocfg, mcfg, mesh, donate=False,
+                                    state_sharding=sh)
+        s2, m_fsdp = fsdp_step(sstate, batch)
+        np.testing.assert_allclose(float(m_repl["loss"]),
+                                   float(m_fsdp["loss"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m_repl["grad_norm"]),
+                                   float(m_fsdp["grad_norm"]), rtol=1e-4)
+        # params stayed sharded after the update
+        leaves = [l for l in jax.tree_util.tree_leaves(s2.params)
+                  if hasattr(l, "sharding") and l.sharding.spec != P()]
+        assert leaves, "update lost the FSDP sharding"
+
+    def test_tp_matches_replicated(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, model=4), devices8)
+        mcfg, ocfg, state = _make("vit-tiny", mesh)
+        batch = synthetic_batch(8, 16, 7)
+        bsh = NamedSharding(mesh, P("data"))
+        batch = {k: jax.device_put(v, bsh) for k, v in batch.items()}
+
+        repl_step = make_train_step(ocfg, mcfg, mesh, donate=False)
+        _, m_repl = repl_step(state, batch)
+
+        sh = state_shardings(state, mesh, tp=True, fsdp=False)
+        sstate = shard_state(state, sh)
+        tp_step = make_train_step(ocfg, mcfg, mesh, donate=False,
+                                  state_sharding=sh)
+        _, m_tp = tp_step(sstate, batch)
+        np.testing.assert_allclose(float(m_repl["loss"]), float(m_tp["loss"]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(m_repl["accuracy"]),
+                                   float(m_tp["accuracy"]), rtol=1e-5)

@@ -69,8 +69,10 @@ class ModelConfig:
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
     # Attention implementation for attention-bearing backbones (ViT):
-    # 'dense' (einsum softmax) or 'flash' (Pallas blockwise online-softmax,
-    # tpuic/kernels/flash_attention.py). CNNs ignore this.
+    # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,
+    # tpuic/kernels/flash_attention.py), or 'ring' (sequence-parallel ring
+    # attention over the mesh 'seq' axis, tpuic/parallel/ring_attention.py).
+    # CNNs ignore this.
     attention: str = "dense"
 
 
@@ -120,16 +122,24 @@ class MeshConfig:
     """Device-mesh axes.
 
     The reference's only strategy is data parallelism (train.py:128). We build
-    the mesh with both a ``data`` and a ``model`` axis so tensor-parallel
-    sharding can be added without a rewrite (SURVEY.md §2c). model=1 means
-    pure DP — and until param partitioning is wired into the train step,
-    model>1 only narrows the data axis; leave it at 1.
+    the mesh with a ``data`` axis (batch sharding — the DDP equivalent), a
+    ``seq`` axis (sequence/context parallelism: ring attention shards the
+    token dim of attention-bearing models over it), and a ``model`` axis
+    (Megatron-style tensor parallelism over attention heads / MLP hidden).
+    seq=1, model=1 means pure DP — reference parity.
     data=0 => inferred from device count.
     """
 
-    data: int = 0  # 0 => all devices / model
+    data: int = 0  # 0 => all devices / (seq * model)
+    seq: int = 1
     model: int = 1
-    axis_names: Sequence[str] = ("data", "model")
+    axis_names: Sequence[str] = ("data", "seq", "model")
+    # FSDP/ZeRO-3: shard large params + Adam moments over the data axis
+    # (tpuic/parallel/sharding.py). False => replicated state, DDP semantics.
+    fsdp: bool = False
+    # Map models' logical 'model' axis onto the mesh model axis (Megatron TP).
+    # Only meaningful when model > 1.
+    tensor_parallel: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -8,12 +8,12 @@ kernel is the per-device block primitive: the forward never materializes the
 and contractions are MXU-shaped with a float32 online softmax carried across
 key blocks.
 
-Backward is recompute-based (jax.custom_vjp): probabilities are rebuilt by
-differentiating a dense float32-softmax form that matches the forward
-kernel's numerics. This means the *backward* pass does materialize O(N²)
-attention scores (standard dense memory); the flash memory win currently
-applies to inference and to the forward residuals (q, k, v only — no saved
-probabilities). A blockwise Pallas backward is the planned upgrade.
+Backward is blockwise Pallas too (jax.custom_vjp): the forward saves only
+(q, k, v, o, logsumexp) — no probability matrix — and two backward kernels
+rebuild [block_q, block_k] probability tiles in VMEM from the saved
+logsumexp: a dq kernel gridded over query blocks and a dk/dv kernel gridded
+over key blocks, both using the standard FlashAttention identity
+ds = p * (dp - rowsum(do·o)). Peak HBM stays O(N·D) end to end.
 
 Sharding: a Pallas call is an opaque custom call — GSPMD/Shardy cannot
 partition it and would all-gather batch-sharded operands onto every device.
@@ -41,7 +41,7 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                valid_len: int):
+                valid_len: int, lse_ref=None):
     """One (batch*head, q-block) program: online softmax over key blocks."""
     q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
     bq = q.shape[0]
@@ -69,6 +69,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
         m = m_new
 
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # logsumexp per query row, the only softmax residual the backward
+        # needs. Fully-masked (padded-q) rows get a finite sentinel.
+        lse_ref[0] = jnp.where(
+            m[:, 0] > _NEG_INF / 2,
+            m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), 0.0)
 
 
 def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
@@ -78,26 +84,49 @@ def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
     return jnp.pad(t, ((0, 0), (0, pad), (0, 0)))
 
 
+def _fold(t, b, h, n, d, n_padded):  # [B,N,H,D] -> [B*H, N_padded, D]
+    t = jnp.transpose(t, (0, 2, 1, 3)).reshape(b * h, n, d)
+    return _pad_seq(t, n_padded)
+
+
+def _unfold(t, b, h, n, d):  # [B*H, N_padded, D] -> [B,N,H,D]
+    t = t[:, :n].reshape(b, h, n, d)
+    return jnp.transpose(t, (0, 2, 1, 3))
+
+
+def _padded_len(n: int, block_q: int, block_k: int) -> int:
+    return max(-(-n // block_q) * block_q, -(-n // block_k) * block_k)
+
+
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
-                                             "interpret"))
-def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [B, N, H, D] -> out [B, N, H, D]. Single-device (or per-shard)."""
+                                             "interpret", "with_lse"))
+def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
+               with_lse: bool = False):
+    """q,k,v: [B, N, H, D] -> out [B, N, H, D] (and logsumexp [B*H, N_padded]
+    when with_lse — the backward residual). Single-device (or per-shard)."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    n_pad_q = -(-n // block_q) * block_q
-    n_pad_k = -(-n // block_k) * block_k
-    n_padded = max(n_pad_q, n_pad_k)
+    n_padded = _padded_len(n, block_q, block_k)
 
-    def fold(t):  # [B,N,H,D] -> [B*H, N_padded, D]
-        t = jnp.transpose(t, (0, 2, 1, 3)).reshape(b * h, n, d)
-        return _pad_seq(t, n_padded)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf = _fold(q, b, h, n, d, n_padded)
+    kf = _fold(k, b, h, n, d, n_padded)
+    vf = _fold(v, b, h, n, d, n_padded)
     grid = (b * h, n_padded // block_q)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                          valid_len=n),
-        out_shape=jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype),
+    out_shape = [jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                              memory_space=pltpu.VMEM)]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b * h, n_padded), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                                      memory_space=pltpu.VMEM))
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
+        _fwd_kernel(q_ref, k_ref, v_ref, o_ref, block_k=block_k, scale=scale,
+                    valid_len=n, lse_ref=rest[0] if rest else None)
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
@@ -107,28 +136,139 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool):
             pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=out_specs,
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * n_padded * n_padded * d,
             bytes_accessed=3 * b * h * n_padded * d * q.dtype.itemsize,
             transcendentals=b * h * n_padded * n_padded),
     )(qf, kf, vf)
-    out = out[:, :n].reshape(b, h, n, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out = _unfold(res[0], b, h, n, d)
+    if with_lse:
+        return out, res[1]
+    return out
 
 
-def _dense_attention_f32(q, k, v):
-    """Dense reference with the same numerics as the kernel: f32 scores, f32
-    softmax, f32 p·v contraction, cast to input dtype at the end. Used for the
-    recompute backward so the gradient is of the function the forward computed."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (1.0 / (d ** 0.5))
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_k: int, scale: float, valid_len: int):
+    """One (batch*head, q-block) program: dq = scale * Σ_j ds_j @ k_j."""
+    q = q_ref[0].astype(jnp.float32)                     # [bq, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                            # [bq, 1]
+    delta = delta_ref[0][:, None]
+    bq, d = q.shape
+    n_padded = k_ref.shape[1]
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    for j in range(n_padded // block_k):
+        kj = k_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
+        vj = v_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(kpos < valid_len, s, _NEG_INF)
+        p = jnp.exp(s - lse)                             # [bq, bk]
+        dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc += jnp.dot(ds, kj, preferred_element_type=jnp.float32)
+
+    dq_ref[0] = (scale * acc).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, scale: float,
+                    valid_len: int):
+    """One (batch*head, k-block) program: dk/dv accumulated over q blocks."""
+    kb = k_ref[0].astype(jnp.float32)                    # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    n_padded = q_ref.shape[1]
+    j = pl.program_id(1)
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # [1, bk]
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    for i in range(n_padded // block_q):
+        qi = q_ref[0, i * block_q:(i + 1) * block_q, :].astype(jnp.float32)
+        doi = do_ref[0, i * block_q:(i + 1) * block_q, :].astype(jnp.float32)
+        lse = lse_ref[0, i * block_q:(i + 1) * block_q][:, None]
+        delta = delta_ref[0, i * block_q:(i + 1) * block_q][:, None]
+        s = scale * jax.lax.dot_general(qi, kb, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        s = jnp.where(kpos < valid_len, s, _NEG_INF)     # [bq, bk]
+        p = jnp.exp(s - lse)
+        dv += jax.lax.dot_general(p, doi, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(doi, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                            # [bq, bk]
+        dk += scale * jax.lax.dot_general(
+            ds, qi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
+               interpret: bool):
+    """Blockwise backward: (dq, dk, dv), each [B, N, H, D]. lse is the folded
+    [B*H, N_padded] logsumexp saved by the forward."""
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_padded = _padded_len(n, block_q, block_k)
+
+    qf, kf, vf, of, dof = (_fold(t, b, h, n, d, n_padded)
+                           for t in (q, k, v, o, do))
+    # delta_i = rowsum(do_i * o_i): the softmax-jacobian correction term.
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    blk = lambda bsz: pl.BlockSpec((1, bsz, d), lambda i, j: (i, j, 0),
+                                   memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    row_blk = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j: (i, j),
+                                       memory_space=pltpu.VMEM)
+    row_full = pl.BlockSpec((1, n_padded), lambda i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
+                          valid_len=n),
+        out_shape=jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype),
+        grid=(b * h, n_padded // block_q),
+        in_specs=[blk(block_q), full, full, blk(block_q),
+                  row_blk(block_q), row_blk(block_q)],
+        out_specs=blk(block_q),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * h * n_padded * n_padded * d,
+            bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
+                          valid_len=n),
+        out_shape=[jax.ShapeDtypeStruct((b * h, n_padded, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, n_padded, d), v.dtype)],
+        grid=(b * h, n_padded // block_k),
+        in_specs=[full, blk(block_k), blk(block_k), full,
+                  row_full, row_full],
+        out_specs=[blk(block_k), blk(block_k)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * h * n_padded * n_padded * d,
+            bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (_unfold(dq, b, h, n, d), _unfold(dk, b, h, n, d),
+            _unfold(dv, b, h, n, d))
 
 
 def _shard_batch(mesh: Optional[Mesh], b: int) -> bool:
@@ -147,30 +287,44 @@ def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
     bidirectional). ``interpret=None`` auto-selects interpret mode off-TPU;
     ``mesh`` keeps the kernel batch-parallel under a sharded jit (see module
     docstring)."""
+    return _batch_parallel(
+        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp),
+        mesh, interpret, 1, q, k, v)
+
+
+def _batch_parallel(fn, mesh, interpret, n_out, *operands):
+    """Run ``fn(interpret, *operands)`` per batch shard under shard_map when
+    the mesh shards the batch, else directly. Pallas calls are opaque to
+    GSPMD, so without this a sharded jit would all-gather the operands onto
+    every device. check_vma=False: pallas out_shapes carry no vma
+    annotations. All operands/outputs are batch-major."""
     if interpret is None:
         from tpuic.kernels import default_interpret
         interpret = default_interpret()
-    if _shard_batch(mesh, q.shape[0]):
-        spec = P("data")
-        return jax.shard_map(
-            lambda a, b_, c: _flash_fwd(a, b_, c, block_q, block_k, interpret),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,  # pallas out_shapes carry no vma annotations
-        )(q, k, v)
-    return _flash_fwd(q, k, v, block_q, block_k, interpret)
+    if not _shard_batch(mesh, operands[0].shape[0]):
+        return fn(interpret, *operands)
+    spec = P("data")
+    return jax.shard_map(
+        lambda *ops: fn(interpret, *ops),
+        mesh=mesh, in_specs=(spec,) * len(operands),
+        out_specs=spec if n_out == 1 else (spec,) * n_out,
+        check_vma=False,
+    )(*operands)
 
 
 def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh):
-    out = flash_attention(q, k, v, block_q, block_k, interpret, mesh)
-    return out, (q, k, v)
+    out, lse = _batch_parallel(
+        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
+                                        with_lse=True),
+        mesh, interpret, 2, q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(block_q, block_k, interpret, mesh, res, g):
-    q, k, v = res
-    # Recompute-based backward (see module docstring): plain jnp ops, which
-    # GSPMD shards over the batch axis natively — no shard_map needed.
-    _, pullback = jax.vjp(_dense_attention_f32, q, k, v)
-    return pullback(g)
+    q, k, v, out, lse = res
+    return _batch_parallel(
+        lambda interp, *ops: _flash_bwd(*ops, block_q, block_k, interp),
+        mesh, interpret, 3, q, k, v, out, lse, g)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

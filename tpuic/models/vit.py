@@ -32,7 +32,7 @@ def _dense(features, name, dtype, param_dtype, logical):
     )
 
 
-ATTENTION_IMPLS = ("dense", "flash")
+ATTENTION_IMPLS = ("dense", "flash", "ring")
 
 
 class MultiHeadAttention(nn.Module):
@@ -40,11 +40,15 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     # 'dense': einsum + f32 softmax. 'flash': Pallas blockwise online-softmax
-    # kernel (tpuic/kernels/flash_attention.py) — forward never materializes
-    # the [N,N] probability matrix; backward is dense recompute (see kernel).
+    # kernels, forward AND backward — neither materializes the [N,N]
+    # probability matrix (tpuic/kernels/flash_attention.py).
+    # 'ring': sequence-parallel ring attention over the mesh's 'seq' axis
+    # (tpuic/parallel/ring_attention.py) — K/V blocks rotate via ppermute;
+    # falls back to 'dense' numerics when the mesh has no seq sharding.
     attention: str = "dense"
     # Device mesh: keeps the flash kernel batch-parallel under a sharded jit
-    # (shard_map over the 'data' axis); None => single-device pallas_call.
+    # (shard_map over the 'data' axis) and carries the 'seq' axis for ring
+    # attention; None => single-device pallas_call / dense.
     mesh: Any = None
 
     @nn.compact
@@ -65,6 +69,10 @@ class MultiHeadAttention(nn.Module):
         if self.attention == "flash":
             from tpuic.kernels import flash_attention
             out = flash_attention(q, k, v, 128, 128, None, self.mesh)
+        elif (self.attention == "ring" and self.mesh is not None
+              and self.mesh.shape.get("seq", 1) > 1):
+            from tpuic.parallel import ring_attention
+            out = ring_attention(q, k, v, self.mesh)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

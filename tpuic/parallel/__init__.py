@@ -1,3 +1,4 @@
 from tpuic.parallel.collectives import (  # noqa: F401
     pmean_tree, psum_scalar, global_mean, all_gather_batch,
 )
+from tpuic.parallel.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,118 @@
+"""Ring attention — sequence/context parallelism over a mesh ``seq`` axis.
+
+The reference has no sequence axis at all (SURVEY.md §5: fixed 299x299 CNN
+inputs; its only parallelism is DDP data parallelism, train.py:128). This
+module is the framework's long-context story, designed TPU-first rather than
+ported: the token dimension of softmax attention is sharded over a ``seq``
+mesh axis, each device holds one K/V block, and blocks rotate around the ICI
+ring with ``lax.ppermute`` while a float32 online softmax accumulates — the
+blockwise/RingAttention formulation (Liu et al., 2023). Peak memory per
+device is O(N/P · N/P) for the score tile instead of O(N²), and each
+ppermute is a neighbor hop on the torus, overlapped by XLA's latency-hiding
+scheduler with the block matmuls.
+
+Why not a port: a GPU implementation would be NCCL send/recv with manual
+double-buffering; here the whole rotation is traced into one XLA program
+via ``shard_map`` + ``ppermute`` and the compiler owns scheduling.
+
+Autodiff: the rotation is plain traced ``jnp`` + ``ppermute`` (whose
+transpose is the reverse permute), so ``jax.grad`` through the sharded
+attention yields the reverse ring automatically — no custom VJP needed.
+
+Layout: [B, N, H, D] ("bqhd", matching models/vit.py). N is padded up to a
+multiple of the ring size; padded key positions are masked to -inf, padded
+query rows are sliced off, so any sequence length works (ViT's 197 tokens
+included).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_local(q, k, v, *, axis_name: str, ring_size: int, n_valid: int,
+                n_local: int, scale: float):
+    """Per-device body under shard_map: q is this device's query block
+    [b, nq, H, D]; k/v start as this device's key block and rotate."""
+    idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * scale
+    b, nq, h, d = qf.shape
+    # Score space is [b, h, nq, bk]; accumulators carried across ring steps.
+    m = jnp.full((b, h, nq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, nq, 1), jnp.float32)
+    acc = jnp.zeros((b, h, nq, d), jnp.float32)
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    for step in range(ring_size):  # ring_size is static: unrolled by trace
+        # With src->dst (i, i+1), after `step` hops we hold block idx-step.
+        block_id = (idx - step) % ring_size
+        kpos = block_id * n_local + lax.broadcasted_iota(
+            jnp.int32, (b, h, nq, n_local), 3)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        s = jnp.where(kpos < n_valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m = m_new
+        if step != ring_size - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)  # padded q rows (l=0) are sliced off
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b, nq, H, D]
+
+
+def _pad_tokens(t: jnp.ndarray, to: int) -> jnp.ndarray:
+    pad = to - t.shape[1]
+    if pad == 0:
+        return t
+    return jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+                   batch_axis: Optional[str] = "data",
+                   head_axis: Optional[str] = "model"):
+    """Bidirectional softmax attention with the sequence dim sharded over
+    ``mesh.shape[seq_axis]`` devices. q, k, v, out: [B, N, H, D].
+
+    Batch is additionally sharded over ``batch_axis`` when it divides B
+    (composing SP with DP), and heads over ``head_axis`` when it divides H
+    (composing SP with Megatron TP — heads are independent, so a TP mesh's
+    head-sharded activations stay sharded instead of being all-gathered).
+    Falls back to a single-block computation when the seq axis has size 1 —
+    same numerics, no collectives.
+    """
+    if seq_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
+    ring = mesh.shape[seq_axis]
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_local = -(-n // ring)
+    n_padded = n_local * ring
+    q, k, v = (_pad_tokens(t, n_padded) for t in (q, k, v))
+
+    def _shardable(axis, dim):
+        return (axis is not None and axis in mesh.axis_names
+                and mesh.shape[axis] > 1 and dim % mesh.shape[axis] == 0)
+
+    spec = P(batch_axis if _shardable(batch_axis, b) else None, seq_axis,
+             head_axis if _shardable(head_axis, h) else None)
+    out = jax.shard_map(
+        functools.partial(_ring_local, axis_name=seq_axis, ring_size=ring,
+                          n_valid=n, n_local=n_local, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+    return out[:, :n]

@@ -30,23 +30,25 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    model = max(1, cfg.model)
-    if n % model:
-        raise ValueError(f"model axis {model} does not divide device count {n}")
-    data = cfg.data or n // model
-    if data * model != n:
-        raise ValueError(f"mesh {data}x{model} != device count {n}")
+    seq, model = max(1, cfg.seq), max(1, cfg.model)
+    if n % (seq * model):
+        raise ValueError(f"seq*model axes {seq}x{model} do not divide "
+                         f"device count {n}")
+    data = cfg.data or n // (seq * model)
+    shape = (data, seq, model)
+    if data * seq * model != n:
+        raise ValueError(f"mesh {shape} != device count {n}")
     # Auto axis types: shardings constrain data layout and GSPMD propagates /
     # inserts collectives (jax>=0.9 defaults make_mesh to Explicit
     # sharding-in-types, which instead demands out_sharding annotations on
     # every contraction touching a sharded dim — not the model we want).
-    auto = (jax.sharding.AxisType.Auto,) * 2
+    auto = (jax.sharding.AxisType.Auto,) * len(shape)
     try:
-        return jax.make_mesh((data, model), tuple(cfg.axis_names),
+        return jax.make_mesh(shape, tuple(cfg.axis_names),
                              axis_types=auto, devices=devices)
     except TypeError:
         # Older signature without axis_types/devices kwargs.
-        arr = np.asarray(devices).reshape(data, model)
+        arr = np.asarray(devices).reshape(shape)
         return Mesh(arr, tuple(cfg.axis_names))
 
 

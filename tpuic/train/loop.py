@@ -76,10 +76,22 @@ class Trainer:
         with self.mesh:
             self.state = create_train_state(
                 self.model, tx, jax.random.key(cfg.run.seed), shape)
+        # TP/FSDP state sharding (replicated when neither is requested —
+        # reference DDP semantics).
+        self.state_sharding = None
+        if step_mesh is not None and (cfg.mesh.fsdp or (
+                cfg.mesh.tensor_parallel and self.mesh.shape["model"] > 1)):
+            from tpuic.parallel.sharding import shard_state, state_shardings
+            self.state_sharding = state_shardings(
+                self.state, self.mesh, tp=cfg.mesh.tensor_parallel,
+                fsdp=cfg.mesh.fsdp)
+            self.state = shard_state(self.state, self.state_sharding)
         self.train_step = make_train_step(cfg.optim, mcfg, step_mesh,
                                           lr_schedule=self.schedule,
-                                          seed=cfg.run.seed)
-        self.eval_step = make_eval_step(cfg.optim, mcfg, step_mesh)
+                                          seed=cfg.run.seed,
+                                          state_sharding=self.state_sharding)
+        self.eval_step = make_eval_step(cfg.optim, mcfg, step_mesh,
+                                        state_sharding=self.state_sharding)
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
         self.logger = MetricLogger(log_dir)
@@ -88,6 +100,9 @@ class Trainer:
         if cfg.run.resume:
             self.state, self.start_epoch, self.best_score = \
                 self.ckpt.restore_into(self.state, "best")
+            if self.state_sharding is not None:
+                from tpuic.parallel.sharding import shard_state
+                self.state = shard_state(self.state, self.state_sharding)
 
     # -- epochs -------------------------------------------------------------
     def train_epoch(self, epoch: int) -> float:

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
-from flax.core import FrozenDict
+from flax.core import unfreeze
 
 
 class TrainState(struct.PyTreeNode):
@@ -46,8 +46,11 @@ def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
     params_rng, dropout_rng = jax.random.split(rng)
     variables = model.init({"params": params_rng, "dropout": dropout_rng},
                            dummy, train=True)
-    params = variables.get("params", FrozenDict())
-    batch_stats = variables.get("batch_stats", FrozenDict())
+    # Plain dicts throughout: model.apply(mutable=...) returns plain dicts in
+    # current flax, and jit out_shardings prefix trees must match container
+    # types exactly.
+    params = unfreeze(variables.get("params", {}))
+    batch_stats = unfreeze(variables.get("batch_stats", {}))
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

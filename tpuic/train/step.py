@@ -49,12 +49,17 @@ def _replicated(mesh: Mesh):
 def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                     mesh: Optional[Mesh] = None,
                     lr_schedule: Optional[optax.Schedule] = None,
-                    donate: bool = True, seed: int = 0) -> Callable:
+                    donate: bool = True, seed: int = 0,
+                    state_sharding=None) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
     batch: {'image': [B,H,W,3] f32, 'label': [B] i32, 'mask': [B] f32}.
     B is the *global* batch size; under a mesh the caller provides globally
     sharded arrays (tpuic.data.pipeline handles this).
+
+    state_sharding: optional NamedSharding prefix tree for the TrainState
+    (tpuic.parallel.sharding.state_shardings) — TP/FSDP param+opt sharding.
+    None => fully replicated state (reference DDP semantics).
     """
     class_weights = (jnp.asarray(optim_cfg.class_weights, jnp.float32)
                      if optim_cfg.class_weights else None)
@@ -100,16 +105,17 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     if mesh is None:
         return jax.jit(train_step, donate_argnums=(0,) if donate else ())
     repl, data = _replicated(mesh), _batch_shardings(mesh)
+    st = state_sharding if state_sharding is not None else repl
     return jax.jit(
         train_step,
-        in_shardings=(repl, data),
-        out_shardings=(repl, repl),
+        in_shardings=(st, data),
+        out_shardings=(st, repl),
         donate_argnums=(0,) if donate else (),
     )
 
 
 def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
-                   mesh: Optional[Mesh] = None) -> Callable:
+                   mesh: Optional[Mesh] = None, state_sharding=None) -> Callable:
     """Returns jitted ``eval_step(state, batch) -> metrics``.
 
     metrics: {'correct': Σ 0/1 over valid, 'count': Σ mask,
@@ -146,4 +152,5 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     if mesh is None:
         return jax.jit(eval_step)
     repl, data = _replicated(mesh), _batch_shardings(mesh)
-    return jax.jit(eval_step, in_shardings=(repl, data), out_shardings=repl)
+    st = state_sharding if state_sharding is not None else repl
+    return jax.jit(eval_step, in_shardings=(st, data), out_shardings=repl)
