@@ -34,6 +34,9 @@ class DataConfig:
     # ImageNet normalization stats (reference dp/loader.py:86-91).
     mean: Sequence[float] = (0.485, 0.456, 0.406)
     std: Sequence[float] = (0.229, 0.224, 0.225)
+    # Use the fused C++ prep core (tpuic/native) when its build is available;
+    # False forces the pure-NumPy transform path (identical numerics).
+    native: bool = True
     # Global shuffle seed. The reference shuffles the file list per-rank,
     # unseeded (dp/loader.py:23) — a correctness bug (ranks see inconsistent
     # shards). We seed identically on every host and fold in the epoch.

@@ -89,19 +89,36 @@ class ImageFolderDataset:
              ) -> Tuple[np.ndarray, int, str]:
         """Decode → RGB → resize → [augment] → normalize. Returns
         (HWC float32 image, label, image_id) — reference dp/loader.py:39-61,
-        minus the CHW transpose (TPU convs are NHWC)."""
+        minus the CHW transpose (TPU convs are NHWC).
+
+        Augment decisions are drawn ONCE (transforms.draw_augment, the single
+        source of the RNG stream) and then executed either by the fused
+        native pass (tpuic/native, when built and cfg.native) or by the NumPy
+        transforms — identical output per (seed, epoch, index) either way."""
         path, label = self.samples[index]
         with Image.open(path) as im:
             img = np.asarray(im.convert("RGB") if im.mode not in ("RGB",)
                              else im)
         img = T.to_rgb(img)
-        img = T.resize_nearest(img, self.resize_size)
+        c = self.cfg
         if self.train and rng is not None:
-            c = self.cfg
-            img = T.augment(img, rng, p_vflip=c.p_vflip, p_hflip=c.p_hflip,
-                            p_saturation=c.p_saturation,
-                            p_brightness=c.p_brightness,
-                            p_contrast=c.p_contrast, jitter_lo=c.jitter_lo,
-                            jitter_hi=c.jitter_hi)
-        img = T.normalize(img, self.cfg.mean, self.cfg.std)
+            k, vflip, hflip, color, factor = T.draw_augment(
+                rng, p_vflip=c.p_vflip, p_hflip=c.p_hflip,
+                p_saturation=c.p_saturation, p_brightness=c.p_brightness,
+                p_contrast=c.p_contrast, jitter_lo=c.jitter_lo,
+                jitter_hi=c.jitter_hi)
+        else:
+            k = vflip = hflip = color = 0
+            factor = 1.0
+        if c.native:
+            from tpuic import native
+            out = native.prep_image(
+                np.ascontiguousarray(img), self.resize_size, rot_k=k,
+                vflip=vflip, hflip=hflip, color_op=color, factor=factor,
+                mean=c.mean, std=c.std)
+            if out is not None:
+                return out, label, self.image_id(index)
+        img = T.resize_nearest(img, self.resize_size)
+        img = T.apply_augment(img, k, vflip, hflip, color, factor)
+        img = T.normalize(img, c.mean, c.std)
         return img, label, self.image_id(index)

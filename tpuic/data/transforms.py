@@ -66,29 +66,56 @@ def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
     return np.clip(gray + (img.astype(np.float32) - gray) * factor, 0.0, 255.0)
 
 
-def augment(img: np.ndarray, rng: np.random.Generator,
-            p_vflip: float = 0.5, p_hflip: float = 0.5,
-            p_saturation: float = 0.05, p_brightness: float = 0.05,
-            p_contrast: float = 0.05, jitter_lo: float = 0.9,
-            jitter_hi: float = 1.1) -> np.ndarray:
-    """Train-time augmentation chain, reference dp/loader.py:63-83."""
+def draw_augment(rng: np.random.Generator,
+                 p_vflip: float = 0.5, p_hflip: float = 0.5,
+                 p_saturation: float = 0.05, p_brightness: float = 0.05,
+                 p_contrast: float = 0.05, jitter_lo: float = 0.9,
+                 jitter_hi: float = 1.1):
+    """Draw the augmentation decisions (reference dp/loader.py:63-83 RNG
+    order: rot90 k, vflip, hflip, color branch, factor). Single source of
+    truth for BOTH the NumPy and the native (tpuic/native) execution paths —
+    per (seed, epoch, index) a sample is identical whichever path ran.
+
+    Returns (k, vflip, hflip, color_op, factor); color_op: 0 none,
+    1 saturation, 2 brightness, 3 contrast."""
     k = int(rng.integers(0, 4))  # rot90 k in {0,1,2,3} (dp/loader.py:64-65)
-    if k:
-        img = np.rot90(img, k, axes=(0, 1))
-    if rng.random() < p_vflip:  # dp/loader.py:67-68
-        img = img[::-1, :, :]
-    if rng.random() < p_hflip:  # dp/loader.py:70-71
-        img = img[:, ::-1, :]
+    vflip = rng.random() < p_vflip   # dp/loader.py:67-68
+    hflip = rng.random() < p_hflip   # dp/loader.py:70-71
     # if/elif color chain (dp/loader.py:74-81): at most one op fires.
     r = rng.random()
     factor = jitter_lo + (jitter_hi - jitter_lo) * rng.random()
     if r < p_saturation:
-        img = adjust_saturation(img, factor)
+        color = 1
     elif r < p_saturation + p_brightness:
-        img = adjust_brightness(img, factor)
+        color = 2
     elif r < p_saturation + p_brightness + p_contrast:
+        color = 3
+    else:
+        color = 0
+    return k, vflip, hflip, color, factor
+
+
+def apply_augment(img: np.ndarray, k: int, vflip: bool, hflip: bool,
+                  color: int, factor: float) -> np.ndarray:
+    """Apply pre-drawn augmentation decisions (NumPy path)."""
+    if k:
+        img = np.rot90(img, k, axes=(0, 1))
+    if vflip:
+        img = img[::-1, :, :]
+    if hflip:
+        img = img[:, ::-1, :]
+    if color == 1:
+        img = adjust_saturation(img, factor)
+    elif color == 2:
+        img = adjust_brightness(img, factor)
+    elif color == 3:
         img = adjust_contrast(img, factor)
     return np.ascontiguousarray(img)
+
+
+def augment(img: np.ndarray, rng: np.random.Generator, **kw) -> np.ndarray:
+    """Train-time augmentation chain, reference dp/loader.py:63-83."""
+    return apply_augment(img, *draw_augment(rng, **kw))
 
 
 def normalize(img: np.ndarray, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> np.ndarray:

@@ -1,0 +1,120 @@
+"""Native (C++) host-side data-prep core, bound via ctypes.
+
+The reference delegates its host pipeline to cv2/skimage C code through
+multiple full-image passes (dp/loader.py:39-91). Here the whole per-sample
+chain (nearest resize -> rot90/flip geometry -> color jitter -> normalize) is
+one fused C++ gather pass (dataprep.cpp), compiled on first use with the
+local toolchain and loaded with ctypes (no pybind11 in this image). ctypes
+releases the GIL during the call, so the Loader's thread pool gets real
+parallelism out of it.
+
+Falls back cleanly: ``prep_image`` is None when no compiler is available or
+the build fails; callers (tpuic/data/folder.py) then use the pure-NumPy
+transforms, which are the numeric ground truth the kernel must match
+(tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "dataprep.cpp")
+_LIB = os.path.join(_HERE, "libtpuic_dataprep.so")
+_ABI = 1
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library next to the source. Atomic via rename."""
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            with tempfile.NamedTemporaryFile(
+                    suffix=".so", dir=_HERE, delete=False) as tmp:
+                tmp_path = tmp.name
+            r = subprocess.run(
+                [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+                 "-o", tmp_path],
+                capture_output=True, timeout=120)
+            if r.returncode == 0:
+                os.replace(tmp_path, _LIB)
+                return _LIB
+            os.unlink(tmp_path)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+    return None
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _LIB if os.path.exists(_LIB) else _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            if lib.tpuic_dataprep_abi_version() != _ABI:
+                lib = ctypes.CDLL(_build())  # stale build: recompile
+                if lib.tpuic_dataprep_abi_version() != _ABI:
+                    return None
+            lib.tpuic_prep_image.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_float,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.tpuic_prep_image.restype = None
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+COLOR_NONE, COLOR_SATURATION, COLOR_BRIGHTNESS, COLOR_CONTRAST = 0, 1, 2, 3
+
+
+def prep_image(img: np.ndarray, size: int, *, rot_k: int = 0,
+               vflip: bool = False, hflip: bool = False,
+               color_op: int = COLOR_NONE, factor: float = 1.0,
+               mean=None, std=None) -> Optional[np.ndarray]:
+    """Fused resize+augment+normalize. img: HWC uint8 (C-contiguous).
+    Returns [size, size, 3] float32, or None when the native core is
+    unavailable (caller falls back to NumPy transforms)."""
+    lib = _load()
+    if lib is None:
+        return None
+    from tpuic.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+    img = np.ascontiguousarray(img, np.uint8)
+    assert img.ndim == 3 and img.shape[2] == 3, img.shape
+    mean = np.ascontiguousarray(
+        IMAGENET_MEAN if mean is None else mean, np.float32)
+    std = np.ascontiguousarray(
+        IMAGENET_STD if std is None else std, np.float32)
+    out = np.empty((size, size, 3), np.float32)
+    lib.tpuic_prep_image(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        img.shape[0], img.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size,
+        int(rot_k) & 3, int(bool(vflip)), int(bool(hflip)), int(color_op),
+        float(factor),
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out
