@@ -51,7 +51,7 @@ def test_train_step_loss_decreases():
     step = make_train_step(OCFG, MCFG, mesh=None, donate=False)
     batch = {k: jnp.asarray(v) for k, v in synthetic_batch(8, 32, 3).items()}
     first = None
-    for _ in range(30):
+    for _ in range(12):
         state, metrics = step(state, batch)
         if first is None:
             first = float(metrics["loss"])
@@ -109,6 +109,20 @@ def test_eval_step_exact_counts(devices8):
     m = estep(state, batch)
     assert float(m["count"]) == 10.0
     assert 0.0 <= float(m["correct"]) <= 10.0
+
+
+def test_remat_step_matches_plain_step():
+    """remat must change memory behavior, never numerics."""
+    state = _state()
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(8, 32, 3).items()}
+    plain = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+    remat = make_train_step(OCFG, dataclasses.replace(MCFG, remat=True),
+                            mesh=None, donate=False)
+    s1, m1 = plain(state, batch)
+    s2, m2 = remat(_state(), batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(float(m1["grad_norm"]), float(m2["grad_norm"]),
+                               rtol=1e-5)
 
 
 def test_weighted_ce_in_step_with_class_weights():

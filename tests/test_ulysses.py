@@ -1,0 +1,86 @@
+"""Ulysses (all-to-all head-parallel) sequence parallelism vs dense.
+
+Sibling of tests/test_ring_attention.py on the 8-fake-CPU-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.config import MeshConfig
+from tpuic.parallel import ulysses_attention
+from tpuic.runtime.mesh import make_mesh
+
+
+def _dense(q, k, v):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.key(key), shape, jnp.float32)
+
+
+class TestUlysses:
+    # 197 = ViT-B/16 tokens: exercises padding (197 % 4 != 0); H=4 = seq size
+    @pytest.mark.parametrize("n", [32, 197])
+    def test_matches_dense(self, devices8, n):
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q, k, v = (_rand(i, (4, n, 4, 8)) for i in range(3))
+        got = ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match_dense(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q, k, v = (_rand(i + 9, (2, 24, 4, 8)) for i in range(3))
+        g1 = jax.grad(lambda *a: jnp.sum(ulysses_attention(*a, mesh) ** 2),
+                      (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(_dense(*a) ** 2), (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_indivisible_heads_raises(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q = jnp.zeros((2, 16, 3, 8))  # 3 heads, P=4
+        with pytest.raises(ValueError, match="heads % seq axis"):
+            ulysses_attention(q, q, q, mesh)
+
+    def test_seq_axis_size_one_falls_back(self, devices8):
+        mesh = make_mesh(MeshConfig(data=8, seq=1), devices8)
+        q, k, v = (_rand(i, (8, 16, 2, 8)) for i in range(3))
+        got = ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_ring(self, devices8):
+        """Both SP strategies compute the same function."""
+        from tpuic.parallel import ring_attention
+
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q, k, v = (_rand(i + 30, (2, 40, 4, 8)) for i in range(3))
+        a = ulysses_attention(q, k, v, mesh)
+        b = ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestUlyssesViT:
+    def test_ulysses_vit_matches_dense_vit(self, devices8):
+        from tpuic.models import create_model
+
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        dense = create_model("vit-tiny", 7, dtype="float32", attention="dense")
+        uly = create_model("vit-tiny", 7, dtype="float32",
+                           attention="ulysses", mesh=mesh)
+        x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+        variables = dense.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)),
+                               train=False)
+        a = dense.apply(variables, x, train=False)
+        b = uly.apply(variables, x, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)

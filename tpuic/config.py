@@ -69,13 +69,20 @@ class ModelConfig:
     # BatchNorm momentum/eps matching torch defaults the reference inherits.
     bn_momentum: float = 0.9  # flax convention: ema = m*ema + (1-m)*batch
     bn_eps: float = 1e-5
+    # Rematerialize the forward in the backward pass (jax.checkpoint with the
+    # dots-without-batch-dims policy): trades recompute FLOPs for activation
+    # HBM traffic/footprint — a win when the model is bandwidth-bound or
+    # memory-limited. The reference has no equivalent (torch would need
+    # torch.utils.checkpoint rewiring).
+    remat: bool = False
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
     # Attention implementation for attention-bearing backbones (ViT):
     # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,
-    # tpuic/kernels/flash_attention.py), or 'ring' (sequence-parallel ring
-    # attention over the mesh 'seq' axis, tpuic/parallel/ring_attention.py).
-    # CNNs ignore this.
+    # tpuic/kernels/flash_attention.py), 'ring' (sequence-parallel ring
+    # attention over the mesh 'seq' axis, tpuic/parallel/ring_attention.py),
+    # or 'ulysses' (sequence-parallel all-to-all head redistribution,
+    # tpuic/parallel/ulysses.py). CNNs ignore this.
     attention: str = "dense"
 
 

@@ -32,7 +32,7 @@ def _dense(features, name, dtype, param_dtype, logical):
     )
 
 
-ATTENTION_IMPLS = ("dense", "flash", "ring")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
 
 
 class MultiHeadAttention(nn.Module):
@@ -43,8 +43,10 @@ class MultiHeadAttention(nn.Module):
     # kernels, forward AND backward — neither materializes the [N,N]
     # probability matrix (tpuic/kernels/flash_attention.py).
     # 'ring': sequence-parallel ring attention over the mesh's 'seq' axis
-    # (tpuic/parallel/ring_attention.py) — K/V blocks rotate via ppermute;
-    # falls back to 'dense' numerics when the mesh has no seq sharding.
+    # (tpuic/parallel/ring_attention.py) — K/V blocks rotate via ppermute.
+    # 'ulysses': sequence parallelism via all-to-all head redistribution
+    # (tpuic/parallel/ulysses.py) — needs heads % seq-axis == 0.
+    # Both fall back to 'dense' numerics when the mesh has no seq sharding.
     attention: str = "dense"
     # Device mesh: keeps the flash kernel batch-parallel under a sharded jit
     # (shard_map over the 'data' axis) and carries the 'seq' axis for ring
@@ -73,6 +75,10 @@ class MultiHeadAttention(nn.Module):
               and self.mesh.shape.get("seq", 1) > 1):
             from tpuic.parallel import ring_attention
             out = ring_attention(q, k, v, self.mesh)
+        elif (self.attention == "ulysses" and self.mesh is not None
+              and self.mesh.shape.get("seq", 1) > 1):
+            from tpuic.parallel import ulysses_attention
+            out = ulysses_attention(q, k, v, self.mesh)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -2,3 +2,4 @@ from tpuic.parallel.collectives import (  # noqa: F401
     pmean_tree, psum_scalar, global_mean, all_gather_batch,
 )
 from tpuic.parallel.ring_attention import ring_attention  # noqa: F401
+from tpuic.parallel.ulysses import ulysses_attention  # noqa: F401

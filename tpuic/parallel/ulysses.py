@@ -1,0 +1,102 @@
+"""Ulysses sequence parallelism — all-to-all head-parallel attention.
+
+The second of the framework's two sequence-parallel strategies (the other is
+tpuic/parallel/ring_attention.py; the reference has neither — its only
+parallelism is DDP, train.py:128). DeepSpeed-Ulysses (Jacobs et al., 2023)
+re-shards between the two natural layouts of attention:
+
+    [B, N/P, H, D]  --all_to_all-->  [B, N, H/P, D]
+    (sequence-sharded: how the        (head-sharded: each device runs FULL
+     encoder's elementwise/MLP         softmax attention for its H/P heads —
+     layers want tokens laid out)      heads are independent, no ring needed)
+
+then all-to-alls back after attention. Communication is two all-to-alls of
+the activations per attention call — O(B·N·H·D/P) per device, riding ICI —
+versus ring attention's P ppermute hops of K/V. Ulysses wins when H >= P and
+the per-device full-N score tile fits VMEM/HBM; ring wins for extreme N
+where even one device's full-sequence scores are too large.
+
+Requires H % P == 0 (head count divides the seq-axis size). Autodiff works
+through lax.all_to_all natively — the transpose is the reverse all-to-all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _local_attention(q, k, v, *, scale: float, n_valid: int):
+    """Dense f32-softmax attention on full-sequence, local-heads tensors
+    [B, N, h_loc, D]; padded key positions (>= n_valid) are masked."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    n = s.shape[-1]
+    if n_valid < n:
+        kpos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(kpos < n_valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, scale: float, n_valid: int):
+    """Per-device body under shard_map: seq-sharded in, seq-sharded out."""
+    # [B, N/P, H, D] -> [B, N, H/P, D]: gather sequence, scatter heads.
+    def to_heads(t):
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = _local_attention(to_heads(q), to_heads(k), to_heads(v),
+                           scale=scale, n_valid=n_valid)
+    return to_seq(out)
+
+
+def _pad_tokens(t: jnp.ndarray, to: int) -> jnp.ndarray:
+    pad = to - t.shape[1]
+    if pad == 0:
+        return t
+    return jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+                      batch_axis: Optional[str] = "data"):
+    """Bidirectional softmax attention, [B, N, H, D] in/out, with the token
+    dim sharded over ``mesh.shape[seq_axis]`` and heads redistributed by
+    all-to-all for the attention itself. Composes with batch sharding over
+    ``batch_axis``. Falls back to a single local computation when the seq
+    axis has size 1."""
+    if seq_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
+    p = mesh.shape[seq_axis]
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    if p > 1 and h % p:
+        raise ValueError(f"ulysses needs heads % seq axis == 0, "
+                         f"got H={h}, P={p} (use ring attention instead)")
+    n_local = -(-n // p)
+    n_padded = n_local * p
+    q, k, v = (_pad_tokens(t, n_padded) for t in (q, k, v))
+
+    bshard = (batch_axis is not None and batch_axis in mesh.axis_names
+              and mesh.shape[batch_axis] > 1 and b % mesh.shape[batch_axis] == 0)
+    spec = P(batch_axis if bshard else None, seq_axis)
+    out = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=seq_axis, scale=scale,
+                          n_valid=n),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+    return out[:, :n]

@@ -73,11 +73,23 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         # Per-step dropout/drop-path randomness, deterministic in (seed, step).
         dropout_rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
+        def forward(params, batch_stats, images, rng):
+            variables = {"params": params, "batch_stats": batch_stats}
+            return state.apply_fn(variables, images, train=True,
+                                  mutable=["batch_stats"],
+                                  rngs={"dropout": rng})
+
+        if model_cfg.remat:
+            # Keep only matmul/conv outputs without batch dims (i.e. nothing
+            # activation-sized); the backward recomputes activations instead
+            # of round-tripping them through HBM.
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
         def loss_fn(params):
-            variables = {"params": params, "batch_stats": state.batch_stats}
-            out, mutated = state.apply_fn(variables, images, train=True,
-                                          mutable=["batch_stats"],
-                                          rngs={"dropout": dropout_rng})
+            out, mutated = forward(params, state.batch_stats, images,
+                                   dropout_rng)
             loss = classification_loss(out, labels, class_weights=class_weights,
                                        mask=mask, aux_weight=aux_w,
                                        label_smoothing=smoothing,

@@ -59,7 +59,21 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["bfloat16", "float32"])
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--model-axis", type=int, default=1,
-                   help="mesh model-axis size (1 = pure data parallel)")
+                   help="mesh model-axis size (1 = pure data parallel; >1 = "
+                        "Megatron tensor parallelism from the models' "
+                        "logical axis annotations)")
+    p.add_argument("--seq-axis", type=int, default=1,
+                   help="mesh seq-axis size for sequence-parallel attention "
+                        "(ring/ulysses; attention-bearing backbones only)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="shard params + optimizer moments over the data axis "
+                        "(ZeRO-3 semantics)")
+    p.add_argument("--attention", default="dense",
+                   choices=["dense", "flash", "ring", "ulysses"],
+                   help="attention implementation for ViT backbones")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize the forward in backward (trade FLOPs "
+                        "for activation memory/bandwidth)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the first epoch here")
     p.add_argument("--log-dir", default="", help="metrics.jsonl directory")
@@ -72,7 +86,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
                         batch_size=args.batchsize, num_workers=args.workers),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
-                          dtype=args.dtype),
+                          dtype=args.dtype, attention=args.attention,
+                          remat=args.remat),
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
                           class_weights=weights,
@@ -81,7 +96,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
                       profile_dir=args.profile_dir, seed=args.seed),
-        mesh=MeshConfig(model=args.model_axis),
+        mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
+                        fsdp=args.fsdp),
     )
 
 
