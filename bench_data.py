@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark: real PNG decode -> augment ->
+normalize -> batched host arrays, per host.
+
+SURVEY.md §7 names the input pipeline the #1 hard part (the reference's
+analogue is ``DataLoader(num_workers=6, pin_memory=True)``, train.py:114).
+This measures images/sec/host through ``tpuic.data.Loader`` over a synthetic
+ImageFolder tree (so it runs anywhere), comparing worker-thread counts and
+the fused C++ prep core vs the pure-NumPy path.
+
+Prints one JSON line:
+  {"metric": "loader_images_per_sec_per_host", "value": N, "unit": ...,
+   "detail": {...grid of configs...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+# Loader bench needs no accelerator; force CPU *before* any jax import and
+# again via jax.config (this image's sitecustomize force-registers a remote
+# TPU backend whose init can hang — see tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _measure(loader, epochs=2) -> float:
+    n = 0
+    # epoch 0 warms file cache + thread pools; epoch 1+ timed
+    for _ in loader.epoch(0):
+        pass
+    t0 = time.perf_counter()
+    for e in range(1, 1 + epochs):
+        for batch in loader.epoch(e):
+            n += int(batch["image"].shape[0])
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    from tpuic.config import DataConfig
+    from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pipeline import Loader
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.native import available as native_available
+
+    size = int(os.environ.get("TPUIC_DATA_BENCH_SIZE", "224"))
+    per_class = int(os.environ.get("TPUIC_DATA_BENCH_PER_CLASS", "64"))
+    batch = int(os.environ.get("TPUIC_DATA_BENCH_BATCH", "32"))
+
+    root = tempfile.mkdtemp(prefix="tpuic_databench_")
+    try:
+        make_synthetic_imagefolder(root, classes=("a", "b", "c", "d"),
+                                   per_class=per_class, size=size)
+        results = {}
+        for native in ([True, False] if native_available() else [False]):
+            cfg = DataConfig(data_dir=root, resize_size=size, native=native)
+            ds = ImageFolderDataset(root, "train", size, cfg)
+            for workers in (1, 6, max(1, (os.cpu_count() or 8) - 2)):
+                loader = Loader(ds, batch, mesh=None, shuffle=True,
+                                num_workers=workers, prefetch=4)
+                key = f"native={native},workers={workers}"
+                results[key] = round(_measure(loader), 1)
+        best = max(results.values())
+        print(json.dumps({
+            "metric": "loader_images_per_sec_per_host",
+            "value": best,
+            "unit": "images/sec/host",
+            "detail": {"image_size": size, "batch": batch,
+                       "n_images": per_class * 4, "grid": results},
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

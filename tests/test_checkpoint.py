@@ -62,13 +62,55 @@ def test_restore_missing_is_noop(tmp_path):
 
 
 def test_latest_period_gating(tmp_path):
+    # Reference train.py:183: saves when epoch % 5 == 0 (epoch 0 included).
     import os
     state = _state()
     mgr = CheckpointManager(str(tmp_path), "m", save_period=5)
-    mgr.maybe_save_latest(state, epoch=2, best_score=0.0)  # (2+1)%5 != 0
+    mgr.maybe_save_latest(state, epoch=2, best_score=0.0)  # 2%5 != 0
+    mgr.wait()
     assert not os.path.isdir(os.path.join(mgr.root, "latest"))
-    mgr.maybe_save_latest(state, epoch=4, best_score=0.0)  # (4+1)%5 == 0
+    mgr.maybe_save_latest(state, epoch=5, best_score=0.0)  # 5%5 == 0
+    mgr.wait()
     assert os.path.isdir(os.path.join(mgr.root, "latest"))
+
+
+def test_resume_prefers_newest_track(tmp_path):
+    """Crash-resume: best from epoch 1, latest from epoch 6 — resume must
+    pick latest (the reference replays from best_model, train.py:136)."""
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "m", save_period=2)
+    mgr.save_best(state, epoch=1, best_score=55.0)
+    mgr.maybe_save_latest(state, epoch=6, best_score=55.0)
+    assert mgr.newest_track() == "latest"
+    _, start_epoch, best = mgr.restore_into(_state())
+    assert start_epoch == 7 and best == 55.0
+    # ...and best wins when IT is newer.
+    mgr.save_best(state, epoch=9, best_score=77.0)
+    assert mgr.newest_track() == "best"
+    _, start_epoch, best = mgr.restore_into(_state())
+    assert start_epoch == 10 and best == 77.0
+
+
+def test_fsdp_sharded_roundtrip(tmp_path, devices8):
+    """Save directly from FSDP-sharded arrays (no host gather) and restore
+    bit-exact into a fresh replicated state."""
+    from tpuic.config import MeshConfig
+    from tpuic.parallel.sharding import shard_state, state_shardings
+    from tpuic.runtime.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(), devices8)
+    state = _state()
+    sharding = state_shardings(state, mesh, tp=False, fsdp=True)
+    sharded = shard_state(state, sharding)
+    assert any(not s.is_fully_replicated
+               for s in jax.tree_util.tree_leaves(
+                   jax.tree.map(lambda a: a.sharding, sharded.params)))
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_best(sharded, epoch=0, best_score=1.0)
+    restored, _, _ = mgr.restore_into(_state())
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_lenient_restore_across_architectures(tmp_path):

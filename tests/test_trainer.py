@@ -44,3 +44,27 @@ def test_fit_end_to_end_and_resume(imagefolder, tmp_path, devices8):
     assert trainer2.best_score == pytest.approx(best)
     # fit() with epochs already passed is a no-op, not a retrain.
     assert trainer2.fit() == pytest.approx(best)
+
+
+def test_init_from_torch_checkpoint(imagefolder, tmp_path, devices8):
+    """--init-from: pretrained torch weights land in the live state
+    (reference starts every backbone pretrained, nn/classifier.py:9-21)."""
+    torch = pytest.importorskip("torch")
+    import numpy as np
+    from tests.test_torch_convert import TorchResNet18
+
+    torch.manual_seed(11)
+    tm = TorchResNet18(num_classes=3)
+    ckpt = str(tmp_path / "best_model")
+    torch.save({"epoch": 7, "best_score": 66.0,
+                "state_dict": {f"module.encoder.{k}": v
+                               for k, v in tm.state_dict().items()}}, ckpt)
+
+    cfg = _config(imagefolder, tmp_path)
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, name="resnet18"),
+        run=dataclasses.replace(cfg.run, init_from=ckpt))
+    trainer = Trainer(cfg)
+    got = np.asarray(trainer.state.params["backbone"]["conv1"]["kernel"])
+    want = np.transpose(tm.conv1.weight.detach().numpy(), (2, 3, 1, 0))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

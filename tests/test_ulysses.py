@@ -84,3 +84,22 @@ class TestUlyssesViT:
         b = uly.apply(variables, x, train=False)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestUlyssesWithTP:
+    def test_head_sharded_under_model_axis(self, devices8):
+        """TP composition: heads stay sharded over 'model' — the all-to-all
+        redistributes only each TP rank's local heads (ADVICE r1: ulysses
+        previously all-gathered head-sharded QKV across TP ranks)."""
+        mesh = make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+        q, k, v = (_rand(i, (4, 24, 4, 8)) for i in range(3))  # H=4: 2/tp rank
+        got = ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dense(q, k, v)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_local_heads_indivisible_raises(self, devices8):
+        mesh = make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+        q = jnp.zeros((2, 16, 2, 8))  # H=2 -> 1 local head, P=2
+        with pytest.raises(ValueError, match="heads % seq axis"):
+            ulysses_attention(q, q, q, mesh)

@@ -6,20 +6,26 @@ rank-0 ``torch.save`` of a replicated state_dict):
 
 - **Tracks**: ``{ckpt_dir}/{name}/best`` saved whenever val accuracy improves
   (train.py:173-180) and ``{ckpt_dir}/{name}/latest`` every ``save_period``
-  epochs (train.py:183-188, period 5).
+  epochs (``epoch % period == 0``, matching train.py:183-188).
 - **Payload**: params, batch_stats, opt_state, epoch, best_score — the
   reference saves {'epoch','best_score','state_dict'} (train.py:177-179) and
   silently loses optimizer state across restarts; here it round-trips.
+- **Sharded + async saves**: state arrays are handed to Orbax as they live on
+  device — under FSDP each host writes only its addressable shards, with no
+  full-state host gather — and the write happens on a background thread
+  (AsyncCheckpointer) so training continues during I/O.
 - **Lenient restore** (``lenient_restore``): key-intersection copy exactly like
   train.py:143-148 — only leaves present in BOTH trees with matching shapes
   are taken from the checkpoint — so architecture drift degrades gracefully.
 - **True resume**: the reference restores ``start_epoch`` but restarts its loop
   at 0 anyway (train.py:149-150 vs 161 — latent bug); here the trainer resumes
-  at the saved epoch.
+  from whichever track (latest/best) carries the highest epoch, so a crash
+  long after the last val improvement doesn't replay dozens of epochs.
 """
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Dict, Optional, Tuple
 
@@ -76,29 +82,52 @@ class CheckpointManager:
     def __init__(self, ckpt_dir: str, name: str, save_period: int = 5) -> None:
         self.root = os.path.abspath(os.path.join(ckpt_dir, name))
         self.save_period = save_period
-        self._ckptr = ocp.PyTreeCheckpointer()
+        # Async: save() hands Orbax the (possibly sharded) on-device arrays
+        # and returns; serialization + write happen on a background thread.
+        try:
+            self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        except Exception:  # pragma: no cover — very old orbax
+            self._ckptr = ocp.PyTreeCheckpointer()
         if jax.process_index() == 0:
             os.makedirs(self.root, exist_ok=True)
 
     # -- save ---------------------------------------------------------------
-    def _payload(self, state, epoch: int, best_score: float):
+    def _payload(self, state, epoch: int, best_score: float,
+                 gather: bool = False):
+        """Checkpoint pytree. ``gather=False`` keeps arrays wherever they
+        live (sharded jax.Arrays stay sharded — each host saves only its
+        addressable shards); ``gather=True`` materializes numpy on host
+        (used as a restore template)."""
+        if gather:
+            to_host = lambda t: jax.tree.map(np.asarray, jax.device_get(t))  # noqa: E731
+        else:
+            to_host = lambda t: t  # noqa: E731
         return {
-            "params": jax.tree.map(np.asarray, jax.device_get(state.params)),
-            "batch_stats": jax.tree.map(np.asarray,
-                                        jax.device_get(state.batch_stats)),
-            "opt_state": jax.tree.map(
-                np.asarray, jax.device_get(
-                    jax.tree.map(lambda x: x,
-                                 state.opt_state))),
+            "params": to_host(state.params),
+            "batch_stats": to_host(state.batch_stats),
+            "opt_state": to_host(state.opt_state),
             "meta": {"epoch": np.int64(epoch),
                      "best_score": np.float64(best_score),
                      "step": np.asarray(jax.device_get(state.step))},
         }
 
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed."""
+        if hasattr(self._ckptr, "wait_until_finished"):
+            self._ckptr.wait_until_finished()
+
     def _save(self, track: str, state, epoch: int, best_score: float) -> None:
         path = os.path.join(self.root, track)
+        self.wait()  # one in-flight save at a time; also orders best/latest
         self._ckptr.save(path, self._payload(state, epoch, best_score),
                          force=True)
+        if jax.process_index() == 0:
+            # Sidecar: lets resume pick the newest track without a full
+            # restore of both. Written after save() so an async crash
+            # mid-write can at worst leave a stale (not future) epoch.
+            with open(os.path.join(self.root, f"{track}.meta.json"), "w") as f:
+                json.dump({"epoch": int(epoch),
+                           "best_score": float(best_score)}, f)
 
     def save_best(self, state, epoch: int, best_score: float) -> None:
         """Reference train.py:173-180 — on val-accuracy improvement."""
@@ -107,20 +136,51 @@ class CheckpointManager:
                     f"(epoch {epoch}, score {best_score:.4f})")
 
     def maybe_save_latest(self, state, epoch: int, best_score: float) -> None:
-        """Reference train.py:183-188 — every ``save_period`` epochs."""
-        if (epoch + 1) % self.save_period == 0:
+        """Reference train.py:183-188 — every ``save_period`` epochs
+        (``epoch % period == 0``, so epoch 0 saves, like the reference)."""
+        if epoch % self.save_period == 0:
             self._save("latest", state, epoch, best_score)
             host0_print(f"[ckpt] latest -> {self.root}/latest (epoch {epoch})")
 
     # -- restore ------------------------------------------------------------
-    def restore_into(self, state, track: str = "best"):
+    def _track_epoch(self, track: str) -> Optional[int]:
+        """Saved epoch of a track, or None when absent/unreadable."""
+        if not os.path.isdir(os.path.join(self.root, track)):
+            return None
+        try:
+            with open(os.path.join(self.root, f"{track}.meta.json")) as f:
+                return int(json.load(f)["epoch"])
+        except (OSError, ValueError, KeyError):
+            return -1  # present but no sidecar — restorable, epoch unknown
+
+    def newest_track(self) -> Optional[str]:
+        """The restorable track with the highest saved epoch.
+
+        ``latest`` wins ties — a crash at epoch 90 with ``best`` from epoch
+        40 resumes at 90 instead of replaying 50 epochs (the reference
+        restores only ``best_model``, train.py:136).
+        """
+        self.wait()  # async saves finalize their directory on commit
+        candidates = [(e, t) for t in ("latest", "best")
+                      if (e := self._track_epoch(t)) is not None]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda p: p[0])[1]
+
+    def restore_into(self, state, track: Optional[str] = None):
         """Lenient restore of ``state`` (reference train.py:132-153).
 
-        Returns (state, start_epoch, best_score); (state, 0, 0.0) when no
-        checkpoint exists — mirroring the reference's probe at train.py:136.
-        Optimizer state is restored only on a FULL param match (a partial /
+        ``track=None`` restores the newest of latest/best. Returns
+        (state, start_epoch, best_score); (state, 0, 0.0) when no checkpoint
+        exists — mirroring the reference's probe at train.py:136. Optimizer
+        state is restored only on a FULL param match (a partial /
         cross-architecture load makes saved moments meaningless).
         """
+        self.wait()  # don't read a track an async save is still writing
+        if track is None:
+            track = self.newest_track()
+            if track is None:
+                return state, 0, 0.0
         path = os.path.join(self.root, track)
         if not os.path.isdir(path):
             return state, 0, 0.0
@@ -129,7 +189,7 @@ class CheckpointManager:
         # cross-architecture checkpoint won't fit the template (shape
         # mismatches) — fall back to a raw restore; lenient_restore then
         # salvages the intersecting params and the opt_state is reset.
-        template = self._payload(state, 0, 0.0)
+        template = self._payload(state, 0, 0.0, gather=True)
         try:
             restored = self._ckptr.restore(path, item=template)
         except Exception:

@@ -134,18 +134,296 @@ def convert_resnet(state_dict: Mapping[str, Any],
             continue
 
         # -- head ------------------------------------------------------------
-        if name.startswith("fc"):
-            rest = name[2:].lstrip(".")
-            target = _HEAD_FC.get(rest) if rest else "out"
-            if target is None:
-                continue
-            if leaf == "weight":
-                _set(params, (head_scope, target, "kernel"), _linear(v))
-            elif leaf == "bias":
-                _set(params, (head_scope, target, "bias"), v)
-            continue
+        _put_head_fc(params, name, leaf, v, head_scope)
 
     return {"params": params, "batch_stats": stats}
+
+
+# ---------------------------------------------------------------------------
+# Inception-v3 (torchvision naming; the reference's default backbone,
+# nn/classifier.py:20-23). torchvision BasicConv2d children are `.conv`/`.bn`,
+# exactly like tpuic's ConvBN (models/inception.py) — only block/branch names
+# translate.
+# ---------------------------------------------------------------------------
+
+_INCEPTION_STEM = {
+    "Conv2d_1a_3x3": "stem1", "Conv2d_2a_3x3": "stem2",
+    "Conv2d_2b_3x3": "stem3", "Conv2d_3b_1x1": "stem4",
+    "Conv2d_4a_3x3": "stem5",
+}
+
+# torchvision Mixed_* module -> inception block family (models/inception.py)
+_INCEPTION_FAMILY = {
+    "Mixed_5b": "A", "Mixed_5c": "A", "Mixed_5d": "A",
+    "Mixed_6a": "B",
+    "Mixed_6b": "C", "Mixed_6c": "C", "Mixed_6d": "C", "Mixed_6e": "C",
+    "Mixed_7a": "D",
+    "Mixed_7b": "E", "Mixed_7c": "E",
+}
+
+# per-family branch-name translation torchvision -> tpuic
+_INCEPTION_BRANCH = {
+    "A": {"branch1x1": "b1x1", "branch5x5_1": "b5_1", "branch5x5_2": "b5_2",
+          "branch3x3dbl_1": "b3_1", "branch3x3dbl_2": "b3_2",
+          "branch3x3dbl_3": "b3_3", "branch_pool": "bpool"},
+    "B": {"branch3x3": "b3", "branch3x3dbl_1": "bd_1",
+          "branch3x3dbl_2": "bd_2", "branch3x3dbl_3": "bd_3"},
+    "C": {"branch1x1": "b1x1", "branch7x7_1": "b7_1", "branch7x7_2": "b7_2",
+          "branch7x7_3": "b7_3", "branch7x7dbl_1": "bd_1",
+          "branch7x7dbl_2": "bd_2", "branch7x7dbl_3": "bd_3",
+          "branch7x7dbl_4": "bd_4", "branch7x7dbl_5": "bd_5",
+          "branch_pool": "bpool"},
+    "D": {"branch3x3_1": "b3_1", "branch3x3_2": "b3_2",
+          "branch7x7x3_1": "b7_1", "branch7x7x3_2": "b7_2",
+          "branch7x7x3_3": "b7_3", "branch7x7x3_4": "b7_4"},
+    "E": {"branch1x1": "b1x1", "branch3x3_1": "b3_1",
+          "branch3x3_2a": "b3_2a", "branch3x3_2b": "b3_2b",
+          "branch3x3dbl_1": "bd_1", "branch3x3dbl_2": "bd_2",
+          "branch3x3dbl_3a": "bd_3a", "branch3x3dbl_3b": "bd_3b",
+          "branch_pool": "bpool"},
+}
+
+
+def _put_head_fc(params: Dict, name: str, leaf: str, v: np.ndarray,
+                 head_scope: str) -> bool:
+    """Map the reference MLP head (``fc.0/2/4/6``) or a plain single ``fc``
+    Linear onto the tpuic head scope. Returns True when consumed."""
+    if not (name == "fc" or name.startswith("fc.")):
+        return False
+    rest = name[2:].lstrip(".")
+    target = _HEAD_FC.get(rest) if rest else "out"
+    if target is None:
+        return False
+    if leaf == "weight":
+        _set(params, (head_scope, target, "kernel"), _linear(v))
+    elif leaf == "bias":
+        _set(params, (head_scope, target, "bias"), v)
+    return True
+
+
+def convert_inception(state_dict: Mapping[str, Any],
+                      backbone_scope: str = "backbone",
+                      head_scope: str = "head") -> Dict[str, Dict]:
+    """torchvision ``inception_v3`` (or reference Classifier-over-inception)
+    state_dict -> ``{'params', 'batch_stats'}`` for tpuic InceptionV3.
+
+    Covers the aux head (``AuxLogits.conv0/conv1/fc`` -> ``aux``), which the
+    reference re-heads with a fresh Linear (nn/classifier.py:22-23). Unknown
+    keys are skipped; merge with ``lenient_restore``.
+    """
+    sd = strip_prefixes(state_dict)
+    params: Dict = {}
+    stats: Dict = {}
+
+    def put_convbn(scope: Tuple[str, ...], sub: str, leaf: str,
+                   v: np.ndarray) -> None:
+        if sub == "conv" and leaf == "weight":
+            _set(params, scope + ("conv", "kernel"), _conv(v))
+        elif sub == "bn":
+            if leaf == "weight":
+                _set(params, scope + ("bn", "scale"), v)
+            elif leaf == "bias":
+                _set(params, scope + ("bn", "bias"), v)
+            elif leaf == "running_mean":
+                _set(stats, scope + ("bn", "mean"), v)
+            elif leaf == "running_var":
+                _set(stats, scope + ("bn", "var"), v)
+
+    for key, v in sd.items():
+        parts = key.split(".")
+        leaf = parts[-1]
+
+        if parts[0] in _INCEPTION_STEM and len(parts) == 3:
+            put_convbn((backbone_scope, _INCEPTION_STEM[parts[0]]),
+                       parts[1], leaf, v)
+            continue
+
+        fam = _INCEPTION_FAMILY.get(parts[0])
+        if fam is not None and len(parts) == 4:
+            branch = _INCEPTION_BRANCH[fam].get(parts[1])
+            if branch is None:
+                continue
+            put_convbn((backbone_scope, parts[0].lower().replace("_", ""),
+                        branch), parts[2], leaf, v)
+            continue
+
+        if parts[0] == "AuxLogits":
+            if parts[1] in ("conv0", "conv1") and len(parts) == 4:
+                put_convbn((backbone_scope, "aux", parts[1]), parts[2],
+                           leaf, v)
+            elif parts[1] == "fc" and len(parts) == 3:
+                if leaf == "weight":
+                    _set(params, (backbone_scope, "aux", "fc", "kernel"),
+                         _linear(v))
+                elif leaf == "bias":
+                    _set(params, (backbone_scope, "aux", "fc", "bias"), v)
+            continue
+
+        _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope)
+
+    return {"params": params, "batch_stats": stats}
+
+
+# ---------------------------------------------------------------------------
+# EfficientNet (efficientnet_pytorch naming; reference nn/classifier.py:17-18
+# — that branch is broken upstream, here the intended behavior works).
+# ---------------------------------------------------------------------------
+
+# block-internal leaf module translation efficientnet_pytorch -> tpuic MBConv
+_EFFNET_BLOCK_CONV = {
+    "_expand_conv": "expand_conv", "_depthwise_conv": "dw_conv",
+    "_project_conv": "project_conv",
+}
+_EFFNET_BLOCK_BN = {"_bn0": "expand_bn", "_bn1": "dw_bn", "_bn2": "project_bn"}
+_EFFNET_SE = {"_se_reduce": "reduce", "_se_expand": "expand"}
+
+
+def _effnet_block_coords(variant: str):
+    """Flat efficientnet_pytorch block index -> tpuic ``block{stage}_{rep}``."""
+    from tpuic.models.efficientnet import (_BASE_BLOCKS, _SCALING,
+                                           _round_repeats)
+    _, depth_mult, _ = _SCALING[variant]
+    coords = []
+    for si, (_, _, repeats, _, _) in enumerate(_BASE_BLOCKS):
+        for r in range(_round_repeats(repeats, depth_mult)):
+            coords.append(f"block{si}_{r}")
+    return coords
+
+
+def detect_efficientnet_variant(state_dict: Mapping[str, Any]) -> str:
+    """Infer b0..b3 from the checkpoint itself.
+
+    The flat block count separates b0 (16) and b3 (26); b1 and b2 both have
+    23 blocks, so they are disambiguated by the final block's projection
+    width (320 vs 352 — width multipliers 1.0 vs 1.1)."""
+    from tpuic.models.efficientnet import _SCALING, _round_filters
+
+    sd = strip_prefixes(state_dict)
+    idxs = [int(k.split(".")[1]) for k in sd if k.startswith("_blocks.")]
+    if not idxs:
+        raise ValueError("not an efficientnet_pytorch state_dict "
+                         "(no _blocks.* keys)")
+    n_blocks = max(idxs) + 1
+    candidates = [v for v in _SCALING
+                  if len(_effnet_block_coords(v)) == n_blocks]
+    if not candidates:
+        raise ValueError(f"no known efficientnet variant has {n_blocks} "
+                         f"blocks (b0..b3 supported)")
+    if len(candidates) > 1:
+        proj = sd.get(f"_blocks.{n_blocks - 1}._project_conv.weight")
+        if proj is not None:
+            candidates = [v for v in candidates
+                          if _round_filters(320, _SCALING[v][0])
+                          == proj.shape[0]] or candidates
+    return candidates[0]
+
+
+def convert_efficientnet(state_dict: Mapping[str, Any], variant: str = "b3",
+                         backbone_scope: str = "backbone",
+                         head_scope: str = "head") -> Dict[str, Dict]:
+    """efficientnet_pytorch state_dict -> ``{'params', 'batch_stats'}``.
+
+    ``variant`` ('b0'..'b3') resolves the flat ``_blocks.{i}`` index into the
+    tpuic ``block{stage}_{repeat}`` name (depth multiplier dependent). The
+    package's ``_fc`` single Linear maps to ``head/out``; a reference-style
+    MLP (``fc.0/2/4/6``) maps to the full head.
+    """
+    sd = strip_prefixes(state_dict)
+    coords = _effnet_block_coords(variant)
+    params: Dict = {}
+    stats: Dict = {}
+
+    def put_bn(scope: Tuple[str, ...], leaf: str, v: np.ndarray) -> None:
+        if leaf == "weight":
+            _set(params, scope + ("scale",), v)
+        elif leaf == "bias":
+            _set(params, scope + ("bias",), v)
+        elif leaf == "running_mean":
+            _set(stats, scope + ("mean",), v)
+        elif leaf == "running_var":
+            _set(stats, scope + ("var",), v)
+
+    for key, v in sd.items():
+        parts = key.split(".")
+        leaf = parts[-1]
+
+        if parts[0] == "_blocks" and len(parts) >= 4:
+            idx = int(parts[1])
+            if idx >= len(coords):
+                continue
+            block = coords[idx]
+            mod = parts[2]
+            if mod in _EFFNET_BLOCK_CONV and leaf == "weight":
+                _set(params,
+                     (backbone_scope, block, _EFFNET_BLOCK_CONV[mod],
+                      "kernel"), _conv(v))
+            elif mod in _EFFNET_BLOCK_BN:
+                put_bn((backbone_scope, block, _EFFNET_BLOCK_BN[mod]),
+                       leaf, v)
+            elif mod in _EFFNET_SE:
+                scope = (backbone_scope, block, "se", _EFFNET_SE[mod])
+                if leaf == "weight":
+                    _set(params, scope + ("kernel",), _conv(v))
+                elif leaf == "bias":
+                    _set(params, scope + ("bias",), v)
+            continue
+
+        if parts[0] == "_conv_stem" and leaf == "weight":
+            _set(params, (backbone_scope, "stem_conv", "kernel"), _conv(v))
+        elif parts[0] == "_bn0":
+            put_bn((backbone_scope, "stem_bn"), leaf, v)
+        elif parts[0] == "_conv_head" and leaf == "weight":
+            _set(params, (backbone_scope, "head_conv", "kernel"), _conv(v))
+        elif parts[0] == "_bn1":
+            put_bn((backbone_scope, "head_bn"), leaf, v)
+        elif parts[0] == "_fc":
+            if leaf == "weight":
+                _set(params, (head_scope, "out", "kernel"), _linear(v))
+            elif leaf == "bias":
+                _set(params, (head_scope, "out", "bias"), v)
+        else:
+            _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope)
+
+    return {"params": params, "batch_stats": stats}
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+def detect_arch(state_dict: Mapping[str, Any]) -> str:
+    """Sniff the backbone family from state_dict key shapes."""
+    for k in state_dict:
+        k = k.replace("module.", "").replace("encoder.", "")
+        if k.startswith("Mixed_") or k.startswith("Conv2d_1a"):
+            return "inceptionv3"
+        if k.startswith("_blocks.") or k.startswith("_conv_stem"):
+            return "efficientnet"
+        if k.startswith("layer1.") or k == "conv1.weight":
+            return "resnet"
+    raise ValueError("could not detect backbone family from state_dict keys")
+
+
+def convert_state_dict(state_dict: Mapping[str, Any],
+                       arch: str = "auto", **kw) -> Dict[str, Dict]:
+    """Convert any supported torch state_dict to tpuic trees.
+
+    ``arch``: 'auto' | 'resnet*' | 'inceptionv3' | 'efficientnet-b{0..3}'.
+    """
+    if arch == "auto":
+        arch = detect_arch(state_dict)
+    if arch.startswith("resnet"):
+        return convert_resnet(state_dict, **kw)
+    if arch.startswith("inception"):
+        return convert_inception(state_dict, **kw)
+    if arch.startswith("efficientnet"):
+        # Bare 'efficientnet' (from auto-detection): the variant is derivable
+        # from the checkpoint — guessing one would silently mis-key every
+        # block and lenient_restore would skip the whole backbone.
+        variant = (arch.rsplit("-", 1)[-1] if "-" in arch
+                   else detect_efficientnet_variant(state_dict))
+        return convert_efficientnet(state_dict, variant=variant, **kw)
+    raise ValueError(f"unsupported arch '{arch}'")
 
 
 def load_reference_checkpoint(path: str) -> Dict[str, Any]:
@@ -165,10 +443,11 @@ def load_reference_checkpoint(path: str) -> Dict[str, Any]:
     return {"epoch": 0, "best_score": 0.0, "state_dict": payload}
 
 
-def convert_reference_checkpoint(path: str) -> Dict[str, Any]:
+def convert_reference_checkpoint(path: str,
+                                 arch: str = "auto") -> Dict[str, Any]:
     """File -> ``{'params', 'batch_stats', 'epoch', 'best_score'}``."""
     payload = load_reference_checkpoint(path)
-    tree = convert_resnet(payload["state_dict"])
+    tree = convert_state_dict(payload["state_dict"], arch=arch)
     tree["epoch"] = payload["epoch"]
     tree["best_score"] = payload["best_score"]
     return tree

@@ -119,6 +119,12 @@ class RunConfig:
     ckpt_dir: str = "dtmodel/cp"  # reference train.py:136
     save_period: int = 5  # 'latest' every 5 epochs, train.py:183
     resume: bool = True
+    # Initialize params/batch_stats from a torch checkpoint (reference-layout
+    # ``{'state_dict': ...}`` file or bare state_dict; backbone family
+    # auto-detected) via the converter + lenient restore. The reference
+    # starts every backbone from pretrained torch weights
+    # (nn/classifier.py:9-21); this is the switch-over path for those users.
+    init_from: str = ""
     log_every_steps: int = 1
     # Profiler trace dir ('' disables). The reference has no profiling at all
     # (SURVEY.md §5); jax.profiler makes it nearly free so it is first-class.

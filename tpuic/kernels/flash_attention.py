@@ -40,41 +40,73 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                valid_len: int, lse_ref=None):
-    """One (batch*head, q-block) program: online softmax over key blocks."""
+# Lane width of the (block_q, LANES) f32 scratch that carries the online
+# softmax m/l rows across key-block grid steps (TPU vregs are 128 lanes; a
+# [bq, 1] scratch would not tile).
+_LANES = 128
+
+# Grid semantics for every kernel here: (batch*head, outer-block) are
+# embarrassingly parallel; the innermost axis is the sequential reduction
+# that the VMEM scratch accumulates across.
+_DIM_SEMANTICS = ("parallel", "parallel", "arbitrary")
+
+
+def _compiler_params():
+    try:
+        return pltpu.CompilerParams(dimension_semantics=_DIM_SEMANTICS)
+    except (AttributeError, TypeError):  # older pallas naming
+        return pltpu.TPUCompilerParams(dimension_semantics=_DIM_SEMANTICS)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                block_k: int, scale: float, valid_len: int,
+                n_k_blocks: int):
+    """One (batch*head, q-block, k-block) program.
+
+    The grid's innermost axis walks key blocks sequentially; (m, l, acc)
+    live in VMEM scratch across those steps, so per-program VMEM is
+    O(block_q·D + block_k·D) no matter how long the sequence is.
+    """
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
     q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
     bq = q.shape[0]
-    n_padded = k_ref.shape[1]
-    d = q.shape[-1]
+    kj = k_ref[0].astype(jnp.float32)                    # [bk, D]
+    vj = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bq, bk]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    s = jnp.where(kpos < valid_len, s, _NEG_INF)
 
-    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
+    m = m_s[:, :1]                                       # [bq, 1]
+    l = l_s[:, :1]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_s[...] = acc_s[...] * alpha + jnp.dot(
+        p, vj, preferred_element_type=jnp.float32)
+    m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+    l_s[...] = jnp.broadcast_to(l, l_s.shape)
 
-    for j in range(n_padded // block_k):
-        kj = k_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
-        vj = v_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
-        kpos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        s = jnp.where(kpos < valid_len, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, vj,
-                                    preferred_element_type=jnp.float32)
-        m = m_new
-
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    if lse_ref is not None:
-        # logsumexp per query row, the only softmax residual the backward
-        # needs. Fully-masked (padded-q) rows get a finite sentinel.
-        lse_ref[0] = jnp.where(
-            m[:, 0] > _NEG_INF / 2,
-            m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), 0.0)
+    @pl.when(ki == n_k_blocks - 1)
+    def _finish():
+        lf = l_s[:, :1]
+        mf = m_s[:, :1]
+        o_ref[0] = (acc_s[...] / jnp.maximum(lf, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp per query row, the only softmax residual the backward
+            # needs. Fully-masked (padded-q) rows get a finite sentinel.
+            lse_ref[0] = jnp.where(
+                mf[:, 0] > _NEG_INF / 2,
+                mf[:, 0] + jnp.log(jnp.maximum(lf[:, 0], 1e-30)), 0.0)
 
 
 def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
@@ -111,32 +143,42 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
     qf = _fold(q, b, h, n, d, n_padded)
     kf = _fold(k, b, h, n, d, n_padded)
     vf = _fold(v, b, h, n, d, n_padded)
-    grid = (b * h, n_padded // block_q)
+    n_k_blocks = n_padded // block_k
+    grid = (b * h, n_padded // block_q, n_k_blocks)
     out_shape = [jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype)]
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+    # The o/lse blocks revisit the same tile across the (sequential)
+    # innermost k axis; writes land on the final k step.
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
                               memory_space=pltpu.VMEM)]
     if with_lse:
         out_shape.append(jax.ShapeDtypeStruct((b * h, n_padded), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+        out_specs.append(pl.BlockSpec((1, block_q), lambda i, j, ki: (i, j),
                                       memory_space=pltpu.VMEM))
 
     def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
-        _fwd_kernel(q_ref, k_ref, v_ref, o_ref, block_k=block_k, scale=scale,
-                    valid_len=n, lse_ref=rest[0] if rest else None)
+        lse_ref = rest[0] if with_lse else None
+        scratch = rest[1:] if with_lse else rest
+        _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
+                    block_k=block_k, scale=scale, valid_len=n,
+                    n_k_blocks=n_k_blocks)
 
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * n_padded * n_padded * d,
@@ -149,67 +191,79 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
     return out
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   block_k: int, scale: float, valid_len: int):
-    """One (batch*head, q-block) program: dq = scale * Σ_j ds_j @ k_j."""
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_s, *, block_k: int, scale: float, valid_len: int,
+                   n_k_blocks: int):
+    """One (bh, q-block, k-block) program: dq = scale * Σ_j ds_j @ k_j,
+    accumulated in VMEM scratch across the sequential k axis."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+
     q = q_ref[0].astype(jnp.float32)                     # [bq, D]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, None]                            # [bq, 1]
     delta = delta_ref[0][:, None]
-    bq, d = q.shape
-    n_padded = k_ref.shape[1]
-    acc = jnp.zeros((bq, d), jnp.float32)
+    bq = q.shape[0]
+    kj = k_ref[0].astype(jnp.float32)                    # [bk, D]
+    vj = v_ref[0].astype(jnp.float32)
+    s = scale * jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    s = jnp.where(kpos < valid_len, s, _NEG_INF)
+    p = jnp.exp(s - lse)                                 # [bq, bk]
+    dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    acc_s[...] += jnp.dot(ds, kj, preferred_element_type=jnp.float32)
 
-    for j in range(n_padded // block_k):
-        kj = k_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
-        vj = v_ref[0, j * block_k:(j + 1) * block_k, :].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-        kpos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        s = jnp.where(kpos < valid_len, s, _NEG_INF)
-        p = jnp.exp(s - lse)                             # [bq, bk]
-        dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        acc += jnp.dot(ds, kj, preferred_element_type=jnp.float32)
-
-    dq_ref[0] = (scale * acc).astype(dq_ref.dtype)
+    @pl.when(ki == n_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = (scale * acc_s[...]).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, scale: float,
-                    valid_len: int):
-    """One (batch*head, k-block) program: dk/dv accumulated over q blocks."""
+                    dk_ref, dv_ref, dk_s, dv_s, *, block_q: int, scale: float,
+                    valid_len: int, n_q_blocks: int):
+    """One (bh, k-block, q-block) program: dk/dv accumulated in VMEM scratch
+    across the sequential q axis."""
+    qi_idx = pl.program_id(2)
+
+    @pl.when(qi_idx == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
     kb = k_ref[0].astype(jnp.float32)                    # [bk, D]
     vb = v_ref[0].astype(jnp.float32)
-    bk, d = kb.shape
-    n_padded = q_ref.shape[1]
+    bk = kb.shape[0]
     j = pl.program_id(1)
     kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # [1, bk]
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
 
-    for i in range(n_padded // block_q):
-        qi = q_ref[0, i * block_q:(i + 1) * block_q, :].astype(jnp.float32)
-        doi = do_ref[0, i * block_q:(i + 1) * block_q, :].astype(jnp.float32)
-        lse = lse_ref[0, i * block_q:(i + 1) * block_q][:, None]
-        delta = delta_ref[0, i * block_q:(i + 1) * block_q][:, None]
-        s = scale * jax.lax.dot_general(qi, kb, (((1,), (1,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-        s = jnp.where(kpos < valid_len, s, _NEG_INF)     # [bq, bk]
-        p = jnp.exp(s - lse)
-        dv += jax.lax.dot_general(p, doi, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(doi, vb, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)                            # [bq, bk]
-        dk += scale * jax.lax.dot_general(
-            ds, qi, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    qi = q_ref[0].astype(jnp.float32)                    # [bq, D]
+    doi = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    s = scale * jax.lax.dot_general(qi, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    s = jnp.where(kpos < valid_len, s, _NEG_INF)         # [bq, bk]
+    p = jnp.exp(s - lse)
+    dv_s[...] += jax.lax.dot_general(p, doi, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(doi, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)                                # [bq, bk]
+    dk_s[...] += scale * jax.lax.dot_general(
+        ds, qi, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi_idx == n_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
@@ -226,24 +280,30 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
                            for t in (q, k, v, o, do))
     # delta_i = rowsum(do_i * o_i): the softmax-jacobian correction term.
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    n_q_blocks = n_padded // block_q
+    n_k_blocks = n_padded // block_k
 
-    blk = lambda bsz: pl.BlockSpec((1, bsz, d), lambda i, j: (i, j, 0),
+    # Index maps: axis 1 is the block this program OWNS (q-block for dq,
+    # k-block for dk/dv); axis 2 is the sequential reduction axis.
+    own = lambda bsz: pl.BlockSpec((1, bsz, d), lambda i, j, r: (i, j, 0),
                                    memory_space=pltpu.VMEM)
-    full = pl.BlockSpec((1, n_padded, d), lambda i, j: (i, 0, 0),
-                        memory_space=pltpu.VMEM)
-    row_blk = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j: (i, j),
+    red = lambda bsz: pl.BlockSpec((1, bsz, d), lambda i, j, r: (i, r, 0),
+                                   memory_space=pltpu.VMEM)
+    row_own = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j, r: (i, j),
                                        memory_space=pltpu.VMEM)
-    row_full = pl.BlockSpec((1, n_padded), lambda i, j: (i, 0),
-                            memory_space=pltpu.VMEM)
+    row_red = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j, r: (i, r),
+                                       memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
-                          valid_len=n),
+                          valid_len=n, n_k_blocks=n_k_blocks),
         out_shape=jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype),
-        grid=(b * h, n_padded // block_q),
-        in_specs=[blk(block_q), full, full, blk(block_q),
-                  row_blk(block_q), row_blk(block_q)],
-        out_specs=blk(block_q),
+        grid=(b * h, n_q_blocks, n_k_blocks),
+        in_specs=[own(block_q), red(block_k), red(block_k), own(block_q),
+                  row_own(block_q), row_own(block_q)],
+        out_specs=own(block_q),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=5 * b * h * n_padded * n_padded * d,
@@ -253,13 +313,16 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
-                          valid_len=n),
+                          valid_len=n, n_q_blocks=n_q_blocks),
         out_shape=[jax.ShapeDtypeStruct((b * h, n_padded, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, n_padded, d), v.dtype)],
-        grid=(b * h, n_padded // block_k),
-        in_specs=[full, blk(block_k), blk(block_k), full,
-                  row_full, row_full],
-        out_specs=[blk(block_k), blk(block_k)],
+        grid=(b * h, n_k_blocks, n_q_blocks),
+        in_specs=[red(block_q), own(block_k), own(block_k), red(block_q),
+                  row_red(block_q), row_red(block_q)],
+        out_specs=[own(block_k), own(block_k)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=5 * b * h * n_padded * n_padded * d,

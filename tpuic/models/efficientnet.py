@@ -98,9 +98,12 @@ class MBConv(nn.Module):
         if self.expand_ratio != 1:
             y = nn.Conv(mid, (1, 1), use_bias=False, **kw, name="expand_conv")(y)
             y = nn.swish(bn(name="expand_bn")(y))
+        # TF-style SAME padding (asymmetric on stride-2) — matches the
+        # efficientnet_pytorch package's Conv2dStaticSamePadding, so torch
+        # checkpoints convert with exact forward parity.
         y = nn.Conv(mid, (self.kernel, self.kernel),
                     strides=(self.strides, self.strides),
-                    padding=self.kernel // 2, feature_group_count=mid,
+                    padding="SAME", feature_group_count=mid,
                     use_bias=False, **kw, name="dw_conv")(y)
         y = nn.swish(bn(name="dw_bn")(y))
         y = SqueezeExcite(mid, max(1, int(self.in_features * self.se_ratio)),
@@ -139,8 +142,8 @@ class EfficientNet(nn.Module):
                      eps=self.bn_eps, **kw)
         x = x.astype(self.dtype)
         stem = _round_filters(32, self.width_mult)
-        x = nn.Conv(stem, (3, 3), strides=(2, 2), padding=1, use_bias=False,
-                    **kw, name="stem_conv")(x)
+        x = nn.Conv(stem, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, **kw, name="stem_conv")(x)
         x = nn.swish(bn(name="stem_bn")(x))
         in_f = stem
         total_blocks = sum(_round_repeats(r, self.depth_mult)

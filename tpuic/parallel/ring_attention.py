@@ -18,6 +18,10 @@ via ``shard_map`` + ``ppermute`` and the compiler owns scheduling.
 Autodiff: the rotation is plain traced ``jnp`` + ``ppermute`` (whose
 transpose is the reverse permute), so ``jax.grad`` through the sharded
 attention yields the reverse ring automatically — no custom VJP needed.
+Each ring step is wrapped in ``jax.checkpoint``, so the backward pass
+recomputes the per-step probability tiles instead of saving all P of them
+— activation memory stays O(N/P · N/P) per device in backward too, not
+O(N²/P).
 
 Layout: [B, N, H, D] ("bqhd", matching models/vit.py). N is padded up to a
 multiple of the ring size; padded key positions are masked to -inf, padded
@@ -38,38 +42,51 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _ring_step(qf, k, v, m, l, acc, *, step: int, axis_name: str,
+               ring_size: int, n_valid: int, n_local: int):
+    """One ring hop: score this device's current K/V block, fold into the
+    online softmax, rotate K/V. Wrapped in jax.checkpoint by the caller so
+    the backward pass recomputes the O(nq·n_local) probability tile instead
+    of saving one per step (which would be O(N²/P) per device)."""
+    idx = lax.axis_index(axis_name)
+    b, nq = qf.shape[0], qf.shape[1]
+    # With src->dst (i, i+1), after `step` hops we hold block idx-step.
+    block_id = (idx - step) % ring_size
+    kpos = block_id * n_local + lax.broadcasted_iota(
+        jnp.int32, (b, 1, nq, n_local), 3)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    s = jnp.where(kpos < n_valid, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    if step != ring_size - 1:
+        perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+    return k, v, m_new, l, acc
+
+
 def _ring_local(q, k, v, *, axis_name: str, ring_size: int, n_valid: int,
                 n_local: int, scale: float):
     """Per-device body under shard_map: q is this device's query block
     [b, nq, H, D]; k/v start as this device's key block and rotate."""
-    idx = lax.axis_index(axis_name)
     qf = q.astype(jnp.float32) * scale
     b, nq, h, d = qf.shape
     # Score space is [b, h, nq, bk]; accumulators carried across ring steps.
     m = jnp.full((b, h, nq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, nq, 1), jnp.float32)
     acc = jnp.zeros((b, h, nq, d), jnp.float32)
-    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
 
     for step in range(ring_size):  # ring_size is static: unrolled by trace
-        # With src->dst (i, i+1), after `step` hops we hold block idx-step.
-        block_id = (idx - step) % ring_size
-        kpos = block_id * n_local + lax.broadcasted_iota(
-            jnp.int32, (b, h, nq, n_local), 3)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
-        s = jnp.where(kpos < n_valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
-        m = m_new
-        if step != ring_size - 1:
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
+        fn = jax.checkpoint(functools.partial(
+            _ring_step, step=step, axis_name=axis_name, ring_size=ring_size,
+            n_valid=n_valid, n_local=n_local))
+        k, v, m, l, acc = fn(qf, k, v, m, l, acc)
 
     out = acc / jnp.maximum(l, 1e-30)  # padded q rows (l=0) are sliced off
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b, nq, H, D]

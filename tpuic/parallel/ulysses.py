@@ -73,27 +73,39 @@ def _pad_tokens(t: jnp.ndarray, to: int) -> jnp.ndarray:
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
-                      batch_axis: Optional[str] = "data"):
+                      batch_axis: Optional[str] = "data",
+                      head_axis: Optional[str] = "model"):
     """Bidirectional softmax attention, [B, N, H, D] in/out, with the token
     dim sharded over ``mesh.shape[seq_axis]`` and heads redistributed by
     all-to-all for the attention itself. Composes with batch sharding over
-    ``batch_axis``. Falls back to a single local computation when the seq
-    axis has size 1."""
+    ``batch_axis`` and with Megatron TP over ``head_axis``: when the model
+    axis already shards heads, the all-to-all only redistributes each TP
+    rank's local heads over the seq axis (needs (H/tp) % P == 0) instead of
+    all-gathering the head-sharded QKV. Falls back to a single local
+    computation when the seq axis has size 1."""
     if seq_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
     p = mesh.shape[seq_axis]
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    if p > 1 and h % p:
-        raise ValueError(f"ulysses needs heads % seq axis == 0, "
-                         f"got H={h}, P={p} (use ring attention instead)")
+
+    def _shardable(axis, dim):
+        return (axis is not None and axis in mesh.axis_names
+                and mesh.shape[axis] > 1 and dim % mesh.shape[axis] == 0)
+
+    hshard = _shardable(head_axis, h)
+    h_local = h // mesh.shape[head_axis] if hshard else h
+    if p > 1 and h_local % p:
+        raise ValueError(
+            f"ulysses needs (local) heads % seq axis == 0, got "
+            f"H={h}{f'/tp={h_local}' if hshard else ''}, P={p} "
+            f"(use ring attention instead)")
     n_local = -(-n // p)
     n_padded = n_local * p
     q, k, v = (_pad_tokens(t, n_padded) for t in (q, k, v))
 
-    bshard = (batch_axis is not None and batch_axis in mesh.axis_names
-              and mesh.shape[batch_axis] > 1 and b % mesh.shape[batch_axis] == 0)
-    spec = P(batch_axis if bshard else None, seq_axis)
+    spec = P(batch_axis if _shardable(batch_axis, b) else None, seq_axis,
+             head_axis if hshard else None)
     out = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=seq_axis, scale=scale,
                           n_valid=n),

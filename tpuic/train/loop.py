@@ -97,12 +97,41 @@ class Trainer:
         self.logger = MetricLogger(log_dir)
         self.start_epoch = 0
         self.best_score = 0.0
+        if cfg.run.init_from:
+            self._init_from_torch(cfg.run.init_from)
         if cfg.run.resume:
+            # Newest of latest/best — a crash after the last val improvement
+            # resumes at the last periodic save instead of replaying epochs.
             self.state, self.start_epoch, self.best_score = \
-                self.ckpt.restore_into(self.state, "best")
+                self.ckpt.restore_into(self.state)
             if self.state_sharding is not None:
                 from tpuic.parallel.sharding import shard_state
                 self.state = shard_state(self.state, self.state_sharding)
+
+    def _init_from_torch(self, path: str) -> None:
+        """Pretrained-weight initialization from a torch checkpoint.
+
+        The reference starts every backbone pretrained (nn/classifier.py:9-21);
+        this converts the torch state_dict (family auto-detected) and merges
+        params + batch_stats leniently — unmapped leaves keep the fresh init,
+        exactly like the reference's partial load (train.py:143-148).
+        """
+        from tpuic.checkpoint.manager import lenient_restore
+        from tpuic.checkpoint.torch_convert import convert_reference_checkpoint
+
+        tree = convert_reference_checkpoint(path)
+        params, n, total = lenient_restore(
+            jax.tree.map(np.asarray, jax.device_get(self.state.params)),
+            tree["params"])
+        stats, n_s, total_s = lenient_restore(
+            jax.tree.map(np.asarray, jax.device_get(self.state.batch_stats)),
+            tree["batch_stats"])
+        self.state = self.state.replace(params=params, batch_stats=stats)
+        if self.state_sharding is not None:
+            from tpuic.parallel.sharding import shard_state
+            self.state = shard_state(self.state, self.state_sharding)
+        host0_print(f"[init] {path}: loaded {n}/{total} param and "
+                    f"{n_s}/{total_s} batch-stat leaves")
 
     # -- epochs -------------------------------------------------------------
     def train_epoch(self, epoch: int) -> float:
@@ -166,5 +195,6 @@ class Trainer:
                 best = score
                 self.ckpt.save_best(self.state, epoch, best)
             self.ckpt.maybe_save_latest(self.state, epoch, best)
+        self.ckpt.wait()  # commit any in-flight async save before returning
         self.best_score = best
         return best

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", default="dtmodel/cp")
     p.add_argument("--save-period", type=int, default=5)
     p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--init-from", default="",
+                   help="initialize from a torch checkpoint (reference "
+                        "best_model/latest_model file or a torchvision/"
+                        "efficientnet_pytorch state_dict); backbone family "
+                        "is auto-detected and weights merge leniently")
     p.add_argument("--workers", type=int, default=6)
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
@@ -95,6 +100,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           warmup_epochs=args.warmup_epochs),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
+                      init_from=args.init_from,
                       profile_dir=args.profile_dir, seed=args.seed),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp),
