@@ -1,16 +1,23 @@
 #!/usr/bin/env python
-"""Input-pipeline throughput benchmark: real PNG decode -> augment ->
-normalize -> batched host arrays, per host.
+"""Input-pipeline throughput benchmark, per host.
 
 SURVEY.md §7 names the input pipeline the #1 hard part (the reference's
 analogue is ``DataLoader(num_workers=6, pin_memory=True)``, train.py:114).
-This measures images/sec/host through ``tpuic.data.Loader`` over a synthetic
-ImageFolder tree (so it runs anywhere), comparing worker-thread counts and
-the fused C++ prep core vs the pure-NumPy path.
+Round-3 context: this host has ONE core (nproc=1), so the per-epoch-decode
+path tops out around ~220 img/s no matter the worker count — the production
+path is the packed uint8 cache (tpuic/data/pack.py): decode once, serve
+epochs from a memmap with augmentation/normalization on the accelerator
+(tpuic/data/device_prep.py).
+
+Measures, over a synthetic ImageFolder tree:
+  - decode-per-epoch Loader grid (native C++ prep on/off x workers) — the
+    legacy path, kept for comparison;
+  - one-time pack build rate (native libjpeg/libpng decode);
+  - the packed Loader's steady-state images/sec/host (headline value).
 
 Prints one JSON line:
   {"metric": "loader_images_per_sec_per_host", "value": N, "unit": ...,
-   "detail": {...grid of configs...}}
+   "detail": {...}}
 """
 
 from __future__ import annotations
@@ -21,30 +28,61 @@ import shutil
 import tempfile
 import time
 
-# Loader bench needs no accelerator; force CPU *before* any jax import and
-# again via jax.config (this image's sitecustomize force-registers a remote
-# TPU backend whose init can hang — see tests/conftest.py).
-os.environ["JAX_PLATFORMS"] = "cpu"
+# The decode-path grid needs no accelerator, but the packed path's
+# augment/normalize runs on the default platform (TPU when present) —
+# matching production. This image's sitecustomize force-registers a remote
+# TPU backend whose init HANGS when the tunnel is down (round-1/2 failure
+# mode), so TPU reachability is probed in a killable child process first;
+# unreachable (or TPUIC_DATA_BENCH_CPU=1) falls back to CPU.
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+        os.environ.pop(v, None)
+
+
+if os.environ.get("TPUIC_DATA_BENCH_CPU"):
+    _force_cpu()
+else:
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=float(os.environ.get("TPUIC_DATA_BENCH_PROBE_S", "90")),
+            capture_output=True)
+        if probe.returncode != 0:
+            _force_cpu()
+    except subprocess.TimeoutExpired:
+        _force_cpu()
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 
-def _measure(loader, epochs=2) -> float:
+def _measure(loader, epochs=2, start=1) -> float:
     n = 0
-    # epoch 0 warms file cache + thread pools; epoch 1+ timed
-    for _ in loader.epoch(0):
-        pass
+    # epoch 0 warms file cache, thread pools, and jit caches; then timed.
+    for batch in loader.epoch(0):
+        last = batch["image"]
+    jax.block_until_ready(last) if hasattr(last, "devices") else None
     t0 = time.perf_counter()
-    for e in range(1, 1 + epochs):
+    for e in range(start, start + epochs):
         for batch in loader.epoch(e):
             n += int(batch["image"].shape[0])
+            last = batch["image"]
+        if hasattr(last, "devices"):
+            jax.block_until_ready(last)
     return n / (time.perf_counter() - t0)
 
 
 def main() -> None:
     from tpuic.config import DataConfig
     from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pack import pack_dataset
     from tpuic.data.pipeline import Loader
     from tpuic.data.synthetic import make_synthetic_imagefolder
     from tpuic.native import available as native_available
@@ -52,6 +90,7 @@ def main() -> None:
     size = int(os.environ.get("TPUIC_DATA_BENCH_SIZE", "224"))
     per_class = int(os.environ.get("TPUIC_DATA_BENCH_PER_CLASS", "64"))
     batch = int(os.environ.get("TPUIC_DATA_BENCH_BATCH", "32"))
+    packed_epochs = int(os.environ.get("TPUIC_DATA_BENCH_EPOCHS", "8"))
 
     root = tempfile.mkdtemp(prefix="tpuic_databench_")
     try:
@@ -59,20 +98,35 @@ def main() -> None:
                                    per_class=per_class, size=size)
         results = {}
         for native in ([True, False] if native_available() else [False]):
-            cfg = DataConfig(data_dir=root, resize_size=size, native=native)
+            cfg = DataConfig(data_dir=root, resize_size=size, native=native,
+                             pack=False)
             ds = ImageFolderDataset(root, "train", size, cfg)
-            for workers in (1, 6, max(1, (os.cpu_count() or 8) - 2)):
+            for workers in (1, 6):
                 loader = Loader(ds, batch, mesh=None, shuffle=True,
                                 num_workers=workers, prefetch=4)
-                key = f"native={native},workers={workers}"
+                key = f"decode,native={native},workers={workers}"
                 results[key] = round(_measure(loader), 1)
-        best = max(results.values())
+
+        # Production path: pack once (decode cost paid once per dataset),
+        # then serve from the memmap with device-side augmentation.
+        cfg = DataConfig(data_dir=root, resize_size=size)
+        ds = ImageFolderDataset(root, "train", size, cfg)
+        t0 = time.perf_counter()
+        packed = pack_dataset(ds, os.path.join(root, ".tpuic_pack"),
+                              verbose=False)
+        results["pack_build"] = round(len(ds) / (time.perf_counter() - t0), 1)
+        loader = Loader(packed, batch, mesh=None, shuffle=True, prefetch=4)
+        packed_rate = round(_measure(loader, epochs=packed_epochs), 1)
+        results["packed"] = packed_rate
+
         print(json.dumps({
             "metric": "loader_images_per_sec_per_host",
-            "value": best,
+            "value": packed_rate,
             "unit": "images/sec/host",
             "detail": {"image_size": size, "batch": batch,
-                       "n_images": per_class * 4, "grid": results},
+                       "n_images": per_class * 4,
+                       "platform": jax.devices()[0].platform,
+                       "grid": results},
         }))
     finally:
         shutil.rmtree(root, ignore_errors=True)

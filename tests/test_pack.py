@@ -1,0 +1,231 @@
+"""Packed-cache pipeline: native decode, pack/reuse, device-side augment.
+
+Round-3 input-pipeline redesign (tpuic/data/pack.py docstring): decode once
+into a memory-mapped uint8 cache, augment/normalize on the accelerator. The
+parity bar: a (seed, epoch, index)-identified sample must be (near-)identical
+whichever path produced it — NumPy decode-per-epoch, native C++, or packed +
+device prep. Geometry is a pure permutation (exact); the float math may
+differ from NumPy at the last ulp (XLA fuses x/255-mean into fma), pinned
+here at 1e-5.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpuic.config import DataConfig
+from tpuic.data import transforms as T
+from tpuic.data.device_prep import (apply_batch_augment, identity_params,
+                                    make_device_prep)
+from tpuic.data.folder import ImageFolderDataset
+from tpuic.data.pack import pack_dataset
+from tpuic.data.pipeline import Loader
+
+
+@pytest.fixture(scope="module")
+def tree(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("packdata"))
+    rng = np.random.default_rng(0)
+    for fold, per in (("train", 6), ("val", 4)):
+        for cls in ("ant", "bee"):
+            d = os.path.join(root, fold, cls)
+            os.makedirs(d)
+            for i in range(per):
+                img = rng.integers(0, 256, (40, 52, 3), np.uint8)
+                Image.fromarray(img).save(os.path.join(d, f"{cls}{i}.png"))
+    return root
+
+
+# -- native decode ----------------------------------------------------------
+
+def test_native_decode_png_bitwise_matches_numpy_path():
+    from tpuic import native
+    if not native.decode_available():
+        pytest.skip("native decode core unavailable")
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, (120, 90, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "PNG")
+    out = native.decode_resize(buf.getvalue(), 64)
+    assert np.array_equal(out, T.resize_nearest(img, 64))
+
+
+def test_native_decode_grayscale_and_palette_png():
+    from tpuic import native
+    if not native.decode_available():
+        pytest.skip("native decode core unavailable")
+    rng = np.random.default_rng(2)
+    gray = rng.integers(0, 256, (50, 60), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(gray, mode="L").save(buf, "PNG")
+    out = native.decode_resize(buf.getvalue(), 32)
+    ref = T.resize_nearest(T.to_rgb(gray), 32)
+    assert np.array_equal(out, ref)
+    pal = Image.fromarray(
+        rng.integers(0, 256, (50, 60, 3), np.uint8)).convert(
+        "P", palette=Image.ADAPTIVE)
+    buf = io.BytesIO()
+    pal.save(buf, "PNG")
+    out = native.decode_resize(buf.getvalue(), 32)
+    ref = T.resize_nearest(T.to_rgb(np.asarray(pal.convert("RGB"))), 32)
+    assert np.array_equal(out, ref)
+
+
+def test_native_decode_jpeg_full_scale_matches_pil():
+    """At full IDCT scale libjpeg output is bitwise PIL's (same library);
+    decode_resize additionally DCT-scales, so compare via tpuic_decode."""
+    import ctypes
+    from tpuic import native
+    if not native.decode_available():
+        pytest.skip("native decode core unavailable")
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (96, 128, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG", quality=92)
+    data = np.frombuffer(buf.getvalue(), np.uint8)
+    lib = native._load_decode()
+    out = np.empty(96 * 128 * 3, np.uint8)
+    h, w = ctypes.c_int(), ctypes.c_int()
+    rc = lib.tpuic_decode(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(data.size),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(out.size), ctypes.byref(h), ctypes.byref(w))
+    assert rc == 0 and (h.value, w.value) == (96, 128)
+    pil = np.asarray(Image.open(io.BytesIO(buf.getvalue())).convert("RGB"))
+    assert np.array_equal(out.reshape(96, 128, 3), pil)
+
+
+def test_native_decode_rejects_garbage():
+    from tpuic import native
+    if not native.decode_available():
+        pytest.skip("native decode core unavailable")
+    assert native.decode_resize(b"\x00" * 64, 32) is None
+    assert native.decode_resize(b"\xff\xd8corrupt jpeg!", 32) is None
+
+
+# -- pack / reuse / invalidation -------------------------------------------
+
+def test_pack_roundtrip_and_reuse(tree, tmp_path):
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    ds = ImageFolderDataset(tree, "train", 32, cfg)
+    cache = str(tmp_path / "cache")
+    packed = pack_dataset(ds, cache, verbose=False)
+    assert len(packed) == len(ds)
+    assert packed.num_classes == ds.num_classes
+    assert packed.classes == ds.classes
+    for i in range(len(ds)):
+        img, label, image_id = ds.load(i)  # no-aug float path
+        pimg, plabel, pid = packed.load(i)
+        assert (label, image_id) == (plabel, pid)
+        np.testing.assert_array_equal(img, pimg)
+    # Reuse: same fingerprint loads without rebuilding (mtime preserved).
+    mtime = os.path.getmtime(packed.bin_path)
+    again = pack_dataset(ds, cache, verbose=False)
+    assert os.path.getmtime(again.bin_path) == mtime
+    # Invalidation: touching a source rebuilds.
+    path0 = ds.samples[0][0]
+    os.utime(path0, (0, 0))
+    rebuilt = pack_dataset(ImageFolderDataset(tree, "train", 32, cfg), cache,
+                           verbose=False)
+    assert os.path.getmtime(rebuilt.bin_path) != mtime
+
+
+# -- device-side augmentation ----------------------------------------------
+
+def test_device_prep_matches_numpy_all_paths():
+    rng = np.random.default_rng(4)
+    B, S = 12, 48
+    imgs = rng.integers(0, 256, (B, S, S, 3), np.uint8)
+    params = {k: [] for k in ("rot", "vflip", "hflip", "color", "factor")}
+    refs = []
+    # Force coverage of every rot/flip/color combination.
+    for i in range(B):
+        k, c = i % 4, i % 4
+        vf, hf = bool(i % 2), bool((i // 2) % 2)
+        f = 0.9 + 0.02 * i
+        for key, v in zip(("rot", "vflip", "hflip", "color", "factor"),
+                          (k, int(vf), int(hf), c, f)):
+            params[key].append(v)
+        refs.append(T.normalize(T.apply_augment(imgs[i], k, vf, hf, c, f)))
+    params = {k: np.asarray(v, np.float32 if k == "factor" else np.int32)
+              for k, v in params.items()}
+    out = np.asarray(apply_batch_augment(imgs, params))
+    assert np.abs(out - np.stack(refs)).max() < 1e-5
+
+
+def test_device_prep_identity_params_is_normalize():
+    from tpuic.data.device_prep import pack_params
+    rng = np.random.default_rng(5)
+    imgs = rng.integers(0, 256, (4, 16, 16, 3), np.uint8)
+    out = np.asarray(make_device_prep()(imgs, pack_params(identity_params(4))))
+    ref = np.stack([T.normalize(im) for im in imgs])
+    assert np.abs(out - ref).max() < 1e-5
+
+
+# -- packed Loader end-to-end ----------------------------------------------
+
+@pytest.mark.parametrize("cache_mb", [4096, 0])
+def test_packed_loader_matches_decode_loader(tree, tmp_path, cache_mb):
+    """Both packed flavors — resident (HBM dataset + index gather) and
+    streaming (per-batch uint8 upload) — must match the decode path."""
+    cfg = DataConfig(data_dir=tree, resize_size=32, device_cache_mb=cache_mb)
+    ds = ImageFolderDataset(tree, "train", 32, cfg)
+    packed = pack_dataset(ds, str(tmp_path / "c2"), verbose=False)
+    legacy = Loader(ds, global_batch=4, seed=7, num_workers=2)
+    fast = Loader(packed, global_batch=4, seed=7)
+    assert fast.packed and not legacy.packed
+    assert fast.resident == (cache_mb > 0)
+    n = 0
+    for a, b in zip(legacy.epoch(2), fast.epoch(2)):
+        np.testing.assert_allclose(a["image"], np.asarray(b["image"]),
+                                   atol=1e-5)
+        np.testing.assert_array_equal(a["label"], np.asarray(b["label"]))
+        np.testing.assert_array_equal(a["mask"], np.asarray(b["mask"]))
+        assert a.image_ids == b.image_ids
+        n += 1
+    assert n == len(legacy)
+
+
+def test_resident_loader_under_mesh(tree, tmp_path):
+    """Resident cache under an 8-device mesh: dataset replicated, indices
+    and output batch sharded over 'data' — gather is shard-local."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from tpuic.config import MeshConfig
+    from tpuic.runtime.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(), jax.devices())
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    ds = ImageFolderDataset(tree, "train", 32, cfg)
+    packed = pack_dataset(ds, str(tmp_path / "c4"), verbose=False)
+    sharded = Loader(packed, global_batch=8, mesh=mesh, seed=7)
+    assert sharded.resident
+    plain = Loader(packed, global_batch=8, seed=7)
+    for a, b in zip(sharded.epoch(1), plain.epoch(1)):
+        img = a["image"]
+        assert img.sharding.spec == P("data")
+        np.testing.assert_allclose(np.asarray(img), np.asarray(b["image"]),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(a["label"]),
+                                      np.asarray(b["label"]))
+
+
+def test_packed_loader_val_no_augment(tree, tmp_path):
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    train_ds = ImageFolderDataset(tree, "train", 32, cfg)
+    ds = ImageFolderDataset(tree, "val", 32, cfg,
+                            class_to_idx=train_ds.class_to_idx)
+    packed = pack_dataset(ds, str(tmp_path / "c3"), verbose=False)
+    assert not packed.train
+    for batch in Loader(packed, global_batch=4, shuffle=False).epoch(0):
+        got = np.asarray(batch["image"])
+        for i, image_id in enumerate(batch.image_ids):
+            if batch["mask"][i] == 0:
+                continue
+            idx = [ds.image_id(j) for j in range(len(ds))].index(image_id)
+            ref = T.normalize(np.asarray(packed.raw(idx)))
+            np.testing.assert_allclose(got[i], ref, atol=1e-5)

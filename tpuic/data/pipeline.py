@@ -15,8 +15,16 @@ The TPU-native replacement for the reference's
   bounded prefetch queue ahead of the device — the analogue of pinned-memory
   prefetch, feeding ``jax.make_array_from_process_local_data`` so each host
   only materializes its own shard of the global batch.
+- **Packed fast path** (round 3): when the dataset is a
+  tpuic.data.pack.PackedDataset (memory-mapped uint8 cache), the producer
+  skips decode entirely — a sample is one memmap row copy — and ships the
+  batch to the device as uint8 (4x less H2D than float32) together with
+  per-sample augmentation decisions; rot90/flips/jitter/normalize run ON
+  the TPU (tpuic/data/device_prep.py). This is how a 1-core host (measured
+  nproc=1) feeds a v5e chip: per-epoch host work is batch assembly only.
 - Per-sample augmentation RNG is ``(seed, epoch, global_index)``-derived:
-  bitwise reproducible regardless of worker count or scheduling.
+  bitwise reproducible regardless of worker count, scheduling, or which
+  path (NumPy / native C++ / device) applied it.
 """
 
 from __future__ import annotations
@@ -34,9 +42,18 @@ from tpuic.data.folder import ImageFolderDataset
 
 
 class Batch(dict):
-    """dict with .image_ids attached (host-side strings never hit the device;
-    the reference ships image_id through the tensor path, dp/loader.py:61)."""
+    """dict with host-side sample identity attached (the reference ships
+    image_id through the tensor path, dp/loader.py:61; strings never hit
+    the device here):
+
+    - ``image_ids``: ids of THIS host's rows of the global batch.
+    - ``indices``: the full global batch's dataset indices — identical on
+      every host (the epoch order is host-replicated), so any host can map
+      a global batch position to an image id (the fixed-shape redesign of
+      the reference's ragged cross-rank gather; see
+      make_eval_step(per_sample=True))."""
     image_ids: List[str]
+    indices: np.ndarray
 
 
 def _epoch_indices(n: int, epoch: int, seed: int, shuffle: bool,
@@ -71,7 +88,13 @@ class Loader:
                  seed: int = 0, num_workers: int = 6, prefetch: int = 2,
                  drop_last: bool = False,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None) -> None:
+                 process_count: Optional[int] = None,
+                 device_cache_bytes: Optional[int] = None) -> None:
+        """``device_cache_bytes`` overrides DataConfig.device_cache_mb for
+        THIS loader — the budget is a per-process total, so a caller that
+        builds several loaders (Trainer: train + val) must split it
+        (see Trainer.__init__) rather than let each loader claim the full
+        amount."""
         self.dataset = dataset
         self.global_batch = int(global_batch)
         self.mesh = mesh
@@ -93,6 +116,45 @@ class Loader:
         self.local_batch = self.global_batch // self.process_count
         self._sharding = (NamedSharding(mesh, P("data")) if mesh is not None
                           else None)
+        # Packed fast path: uint8 memmap rows + device-side augmentation.
+        # Two flavors:
+        # - resident: the whole uint8 dataset fits DataConfig.device_cache_mb
+        #   of HBM -> upload ONCE (replicated under a mesh); a batch ships
+        #   only [B] indices + [B,5] augment params and gathers on device.
+        # - streaming: per-batch uint8 upload + device augment (4x less H2D
+        #   than float, still host-link-bound on slow links).
+        self.packed = hasattr(dataset, "raw")
+        self.resident = False
+        self.resident_bytes = 0
+        self._device_prep = None
+        self._resident_prep = None
+        self._data_dev = None
+        if self.packed:
+            from tpuic.data.device_prep import (make_device_prep,
+                                                make_resident_prep)
+            c = dataset.cfg
+            s = dataset.resize_size
+            data_bytes = len(dataset) * s * s * 3
+            budget = (int(getattr(c, "device_cache_mb", 0)) << 20
+                      if device_cache_bytes is None
+                      else int(device_cache_bytes))
+            if budget and data_bytes <= budget:
+                arr = np.asarray(dataset.array())
+                if mesh is None:
+                    self._data_dev = jax.device_put(arr)
+                    repl = None
+                else:
+                    repl = NamedSharding(mesh, P())
+                    self._data_dev = jax.make_array_from_callback(
+                        arr.shape, repl, lambda idx: arr[idx])
+                self._resident_prep = make_resident_prep(
+                    mean=c.mean, std=c.std, sharding=self._sharding,
+                    replicated=repl)
+                self.resident = True
+                self.resident_bytes = data_bytes
+            else:
+                self._device_prep = make_device_prep(
+                    mean=c.mean, std=c.std, sharding=self._sharding)
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -138,7 +200,63 @@ class Loader:
             except BaseException as e:  # surface worker errors to the consumer
                 _put(e)
 
+        def _produce_packed_loop():
+            """Packed fast path: augment decisions drawn host-side from the
+            SAME (seed, epoch, index) stream as the decode path, applied on
+            device. Resident mode skips even the memmap row copy — the
+            batch payload is the [local_batch] index vector."""
+            from tpuic.data import transforms as T
+            from tpuic.data.device_prep import pack_params
+            ds, c = self.dataset, self.dataset.cfg
+            s = ds.resize_size
+            augment = self.dataset.train
+            for b in range(n_batches):
+                if stop.is_set():
+                    break
+                lo = b * self.global_batch + self.process_index * self.local_batch
+                imgs = (None if self.resident else
+                        np.empty((self.local_batch, s, s, 3), np.uint8))
+                idx = np.zeros((self.local_batch,), np.int32)
+                labels = np.zeros((self.local_batch,), np.int32)
+                mask = np.zeros((self.local_batch,), np.float32)
+                ids = [""] * self.local_batch
+                params = {"rot": np.zeros((self.local_batch,), np.int32),
+                          "vflip": np.zeros((self.local_batch,), np.int32),
+                          "hflip": np.zeros((self.local_batch,), np.int32),
+                          "color": np.zeros((self.local_batch,), np.int32),
+                          "factor": np.ones((self.local_batch,), np.float32)}
+                for i in range(self.local_batch):
+                    gpos = lo + i
+                    index = int(order[gpos])
+                    idx[i] = index
+                    if not self.resident:
+                        imgs[i] = ds.raw(index)
+                    labels[i] = ds.label(index)
+                    mask[i] = 1.0 if gpos < n_valid else 0.0
+                    ids[i] = ds.image_id(index)
+                    if augment:
+                        rng = np.random.default_rng(np.random.SeedSequence(
+                            [self.seed, epoch, index]))
+                        k, vf, hf, color, factor = T.draw_augment(
+                            rng, p_vflip=c.p_vflip, p_hflip=c.p_hflip,
+                            p_saturation=c.p_saturation,
+                            p_brightness=c.p_brightness,
+                            p_contrast=c.p_contrast, jitter_lo=c.jitter_lo,
+                            jitter_hi=c.jitter_hi)
+                        params["rot"][i] = k
+                        params["vflip"][i] = int(vf)
+                        params["hflip"][i] = int(hf)
+                        params["color"][i] = color
+                        params["factor"][i] = factor
+                payload = idx if self.resident else imgs
+                gidx = order[b * self.global_batch:(b + 1) * self.global_batch]
+                if not _put((payload, labels, mask, ids,
+                             pack_params(params), gidx)):
+                    return
+
         def _produce_loop():
+            if self.packed:
+                return _produce_packed_loop()
             with ThreadPoolExecutor(self.num_workers) as pool:
                 for b in range(n_batches):
                     if stop.is_set():
@@ -162,7 +280,9 @@ class Loader:
                         labels[pos] = label
                         mask[pos] = 1.0 if valid else 0.0
                         ids[pos] = image_id
-                    if not _put((imgs, labels, mask, ids)):
+                    gidx = order[b * self.global_batch:
+                                 (b + 1) * self.global_batch]
+                    if not _put((imgs, labels, mask, ids, None, gidx)):
                         return
 
         producer = threading.Thread(target=produce, daemon=True)
@@ -179,11 +299,21 @@ class Loader:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                imgs, labels, mask, ids = item
-                batch = Batch(image=self._to_global(imgs),
+                payload, labels, mask, ids, params, gidx = item
+                if params is None:            # decode path: host float32
+                    image = self._to_global(payload)
+                elif self.resident:           # indices + params only (KBs)
+                    image = self._resident_prep(
+                        self._data_dev, self._to_device(payload),
+                        self._to_device(params))
+                else:                         # streaming uint8 + params
+                    image = self._device_prep(self._to_device(payload),
+                                              self._to_device(params))
+                batch = Batch(image=image,
                               label=self._to_global(labels),
                               mask=self._to_global(mask))
                 batch.image_ids = ids
+                batch.indices = np.asarray(gidx)
                 if pending is not None:
                     yield pending
                 pending = batch
@@ -196,4 +326,11 @@ class Loader:
     def _to_global(self, local: np.ndarray):
         if self._sharding is None:
             return local
+        return jax.make_array_from_process_local_data(self._sharding, local)
+
+    def _to_device(self, local: np.ndarray):
+        """Device placement for packed-path inputs: the jitted device prep
+        needs device arrays even in the no-mesh case."""
+        if self._sharding is None:
+            return jax.device_put(local)
         return jax.make_array_from_process_local_data(self._sharding, local)

@@ -1,17 +1,20 @@
-"""Native (C++) host-side data-prep core, bound via ctypes.
+"""Native (C++) host-side data core, bound via ctypes.
 
-The reference delegates its host pipeline to cv2/skimage C code through
-multiple full-image passes (dp/loader.py:39-91). Here the whole per-sample
-chain (nearest resize -> rot90/flip geometry -> color jitter -> normalize) is
-one fused C++ gather pass (dataprep.cpp), compiled on first use with the
-local toolchain and loaded with ctypes (no pybind11 in this image). ctypes
-releases the GIL during the call, so the Loader's thread pool gets real
-parallelism out of it.
+Two shared libraries, compiled on first use with the local toolchain and
+loaded with ctypes (no pybind11 in this image):
 
-Falls back cleanly: ``prep_image`` is None when no compiler is available or
-the build fails; callers (tpuic/data/folder.py) then use the pure-NumPy
-transforms, which are the numeric ground truth the kernel must match
-(tests/test_native.py).
+- ``dataprep``: the fused resize+augment+normalize gather pass
+  (dataprep.cpp) replacing the reference's multiple full-image numpy/cv2
+  passes (dp/loader.py:39-91).
+- ``decode``: libjpeg/libpng decode + nearest resize (decode.cpp) —
+  JPEG decodes DCT-scaled, so the one-time pack step (tpuic/data/pack.py)
+  that builds the memory-mapped uint8 cache runs at native speed. The host
+  has ONE core (nproc=1, measured round 3), so the pipeline strategy is
+  "decode once, serve from memmap", not worker pools.
+
+Falls back cleanly: each binding is None when no compiler is available or
+the build fails; callers then use PIL + the pure-NumPy transforms, which
+are the numeric ground truth the kernels must match (tests/test_native.py).
 """
 
 from __future__ import annotations
@@ -21,71 +24,134 @@ import os
 import subprocess
 import tempfile
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "dataprep.cpp")
-_LIB = os.path.join(_HERE, "libtpuic_dataprep.so")
-_ABI = 1
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
 
 
-def _build() -> Optional[str]:
-    """Compile the shared library next to the source. Atomic via rename."""
-    for cxx in ("g++", "c++", "clang++"):
-        try:
-            with tempfile.NamedTemporaryFile(
-                    suffix=".so", dir=_HERE, delete=False) as tmp:
-                tmp_path = tmp.name
-            r = subprocess.run(
-                [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-                 "-o", tmp_path],
-                capture_output=True, timeout=120)
-            if r.returncode == 0:
-                os.replace(tmp_path, _LIB)
-                return _LIB
-            os.unlink(tmp_path)
-        except (OSError, subprocess.TimeoutExpired):
-            pass
-    return None
+class _Lib:
+    """Build-on-first-use ctypes library with an ABI version gate."""
 
+    def __init__(self, src: str, soname: str, abi_symbol: str, abi: int,
+                 link: Sequence[str] = ()) -> None:
+        self.src = os.path.join(_HERE, src)
+        self.path = os.path.join(_HERE, soname)
+        self.abi_symbol = abi_symbol
+        self.abi = abi
+        self.link = list(link)
+        self._lib = None
+        self._tried = False
 
-def _load():
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        path = _LIB if os.path.exists(_LIB) else _build()
+    def _build(self) -> Optional[str]:
+        """Compile next to the source. Atomic via rename; the temp .so is
+        always removed on failure (finally-block — ADVICE r1)."""
+        for cxx in ("g++", "c++", "clang++"):
+            tmp_path = None
+            try:
+                with tempfile.NamedTemporaryFile(
+                        suffix=".so", dir=_HERE, delete=False) as tmp:
+                    tmp_path = tmp.name
+                r = subprocess.run(
+                    [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", self.src,
+                     "-o", tmp_path] + self.link,
+                    capture_output=True, timeout=120)
+                if r.returncode == 0:
+                    os.replace(tmp_path, self.path)
+                    tmp_path = None
+                    return self.path
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            finally:
+                if tmp_path is not None:
+                    try:
+                        os.unlink(tmp_path)
+                    except OSError:
+                        pass
+        return None
+
+    def _open(self, path: Optional[str]):
+        """CDLL with the ABI gate. None path (failed build) returns None
+        instead of CDLL(None) == the main program (ADVICE r1)."""
         if path is None:
             return None
         try:
             lib = ctypes.CDLL(path)
-            if lib.tpuic_dataprep_abi_version() != _ABI:
-                lib = ctypes.CDLL(_build())  # stale build: recompile
-                if lib.tpuic_dataprep_abi_version() != _ABI:
-                    return None
-            lib.tpuic_prep_image.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_float), ctypes.c_int,
-                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                ctypes.c_float,
-                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-            ]
-            lib.tpuic_prep_image.restype = None
-            _lib = lib
+            if int(getattr(lib, self.abi_symbol)()) != self.abi:
+                return None
+            return lib
+        except (OSError, AttributeError):
+            return None
+
+    def _fresh(self) -> bool:
+        """On-disk .so exists and is newer than its source."""
+        try:
+            return (os.path.getmtime(self.path)
+                    >= os.path.getmtime(self.src))
         except OSError:
-            _lib = None
-        return _lib
+            return False
+
+    def load(self):
+        with _lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            lib = self._open(self.path if self._fresh() else self._build())
+            if lib is None and os.path.exists(self.path):
+                # Stale on-disk build (old ABI / wrong arch): rebuild once.
+                lib = self._open(self._build())
+            self._lib = lib
+            return self._lib
+
+
+_dataprep = _Lib("dataprep.cpp", "libtpuic_dataprep.so",
+                 "tpuic_dataprep_abi_version", 1)
+_decode = _Lib("decode.cpp", "libtpuic_decode.so",
+               "tpuic_decode_abi_version", 1, link=["-ljpeg", "-lpng"])
+
+
+def _load():
+    lib = _dataprep.load()
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.tpuic_prep_image.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.tpuic_prep_image.restype = None
+        lib._sigs_set = True
+    return lib
+
+
+def _load_decode():
+    lib = _decode.load()
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.tpuic_decode_resize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.tpuic_decode_resize.restype = ctypes.c_int
+        lib.tpuic_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.tpuic_decode.restype = ctypes.c_int
+        lib._sigs_set = True
+    return lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def decode_available() -> bool:
+    return _load_decode() is not None
 
 
 COLOR_NONE, COLOR_SATURATION, COLOR_BRIGHTNESS, COLOR_CONTRAST = 0, 1, 2, 3
@@ -118,3 +184,21 @@ def prep_image(img: np.ndarray, size: int, *, rot_k: int = 0,
         mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     return out
+
+
+def decode_resize(data: bytes, size: int) -> Optional[np.ndarray]:
+    """Decode JPEG/PNG bytes and nearest-resize to [size, size, 3] uint8.
+
+    JPEGs decode DCT-scaled (smallest 1/8..8/8 scale covering ``size``).
+    Returns None when the native decoder is unavailable or the container
+    is unsupported/corrupt (caller falls back to PIL)."""
+    lib = _load_decode()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    out = np.empty((size, size, 3), np.uint8)
+    rc = lib.tpuic_decode_resize(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(buf.size), int(size),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out if rc == 0 else None

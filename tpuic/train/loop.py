@@ -21,6 +21,7 @@ computes it over the global batch, so no extra logging collective exists.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -54,15 +55,31 @@ class Trainer:
         self.train_ds = ImageFolderDataset(d.data_dir, "train", d.resize_size, d)
         self.val_ds = ImageFolderDataset(d.data_dir, "val", d.resize_size, d,
                                          class_to_idx=self.train_ds.class_to_idx)
+        if d.pack:
+            # Decode-once packed cache + device-side augmentation: the only
+            # way a 1-core host feeds the chip (tpuic/data/pack.py docstring).
+            from tpuic.data.pack import pack_dataset
+            cache = d.cache_dir or os.path.join(d.data_dir, ".tpuic_pack")
+            self.train_ds = pack_dataset(self.train_ds, cache,
+                                         verbose=is_host0())
+            self.val_ds = pack_dataset(self.val_ds, cache, verbose=is_host0())
         n_data = self.mesh.shape["data"]
         global_batch = d.batch_size * n_data
+        # The device-cache HBM budget is a per-process TOTAL: the train
+        # loader claims first, val gets what remains (each dataset caches
+        # only when it fits its share — never 2x the configured budget).
+        cache_total = int(d.device_cache_mb) << 20
         self.train_loader = Loader(self.train_ds, global_batch, step_mesh,
                                    seed=d.shuffle_seed, num_workers=d.num_workers,
-                                   prefetch=d.prefetch, drop_last=True)
+                                   prefetch=d.prefetch, drop_last=True,
+                                   device_cache_bytes=cache_total)
         self.val_loader = Loader(self.val_ds,
                                  d.resolved_val_batch_size() * n_data,
                                  step_mesh, shuffle=False,
-                                 num_workers=d.num_workers, prefetch=d.prefetch)
+                                 num_workers=d.num_workers, prefetch=d.prefetch,
+                                 device_cache_bytes=max(
+                                     0, cache_total
+                                     - self.train_loader.resident_bytes))
         num_classes = cfg.model.num_classes or self.train_ds.num_classes
         mcfg = cfg.model
         if num_classes != mcfg.num_classes:
@@ -90,8 +107,10 @@ class Trainer:
                                           lr_schedule=self.schedule,
                                           seed=cfg.run.seed,
                                           state_sharding=self.state_sharding)
-        self.eval_step = make_eval_step(cfg.optim, mcfg, step_mesh,
-                                        state_sharding=self.state_sharding)
+        self.eval_step = make_eval_step(
+            cfg.optim, mcfg, step_mesh, state_sharding=self.state_sharding,
+            per_sample=cfg.run.collect_misclassified)
+        self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
         self.logger = MetricLogger(log_dir)
@@ -140,18 +159,35 @@ class Trainer:
         it = self.train_loader.epoch(epoch)
         bar = tqdm(it, total=len(self.train_loader), disable=not is_host0())
         metrics = None
+        log_every = max(1, self.cfg.run.log_every_steps)
+        global_batch = self.train_loader.global_batch
+        t_log = time.perf_counter()
         for step, batch in enumerate(bar):
             self.state, metrics = self.train_step(
                 self.state, {k: batch[k] for k in ("image", "label", "mask")})
-            if (step + 1) % self.cfg.run.log_every_steps == 0:
-                loss = float(metrics["loss"])  # global mean, device sync point
+            if (step + 1) % log_every == 0:
+                # The ONLY device->host sync in the loop: one scalar readback
+                # per log_every steps (default 50). Reading every step would
+                # block async dispatch and serialize the pipeline
+                # (round-2 finding — bench-grade throughput needs this).
+                loss = float(metrics["loss"])
+                now = time.perf_counter()
+                imgs_per_sec = log_every * global_batch / max(now - t_log,
+                                                              1e-9)
+                t_log = now
                 losses.update(loss, 1)
                 bar.set_description(
                     f"Epoch: {epoch}; Loss {losses.val:.4f}|({losses.avg:.4f})")
                 self.logger.write(int(jax.device_get(self.state.step)),
                                   loss=loss,
                                   accuracy=float(metrics["accuracy"]),
-                                  lr=float(metrics.get("lr", 0.0)))
+                                  lr=float(metrics.get("lr", 0.0)),
+                                  images_per_sec=round(imgs_per_sec, 1))
+        # Epoch-mean loss over all steps, one sync, off the hot path: the
+        # running meter only sees logged points (display semantics identical
+        # to the reference bar, train.py:67-68).
+        if metrics is not None and losses.count == 0:
+            losses.update(float(metrics["loss"]), 1)
         return losses.avg
 
     def val_epoch(self, epoch: int) -> float:
@@ -159,6 +195,8 @@ class Trainer:
         plus the exact global weighted val CE (num/den accumulated
         separately)."""
         correct = count = loss_num = loss_den = 0.0
+        collect = self.cfg.run.collect_misclassified
+        misclassified: list = []
         for batch in self.val_loader.epoch(epoch):
             m = self.eval_step(self.state,
                                {k: batch[k] for k in ("image", "label", "mask")})
@@ -166,12 +204,26 @@ class Trainer:
             count += float(m["count"])
             loss_num += float(m["loss_num"])
             loss_den += float(m["loss_den"])
+            if collect:
+                # 'wrong' is the GLOBAL per-sample vector (replicated out of
+                # the sharded step = all-gather over ICI); batch.indices is
+                # the host-replicated global order — so every host can name
+                # every misclassified sample, reference val_epoch's
+                # all_gather capability (train.py:92) without the pickle.
+                wrong = np.asarray(jax.device_get(m["wrong"]))
+                ds = self.val_loader.dataset
+                misclassified.extend(
+                    ds.image_id(int(batch.indices[pos]))
+                    for pos in np.nonzero(wrong > 0.5)[0])
+        if collect:
+            self.last_misclassified = misclassified
         score = 100.0 * correct / max(count, 1.0)
         val_loss = loss_num / max(loss_den, 1e-12)
         host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}; "
                     f"Val Loss {val_loss:.4f}")
+        extra = {"n_misclassified": len(misclassified)} if collect else {}
         self.logger.write(int(jax.device_get(self.state.step)),
-                          val_accuracy=score, val_loss=val_loss)
+                          val_accuracy=score, val_loss=val_loss, **extra)
         return score
 
     # -- driver -------------------------------------------------------------
