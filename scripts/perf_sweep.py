@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Batch-size / remat sweep of the ResNet-50 train step on the local chip.
+
+Round-3 perf work (VERDICT r2 weak #1): the r2 bench pinned per-chip batch
+at 64 and recorded MFU 0.2655 with no optimization attempted. This script
+measures step time across per-chip batch sizes (and optionally remat) and
+writes perf/sweep.json for PERF_ANALYSIS.md.
+
+Usage: python scripts/perf_sweep.py [--batches 64,128,256] [--remat]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+PEAK_BF16 = 197e12  # TPU v5e
+
+
+def measure(per_chip_batch: int, remat: bool, n_steps: int = 30) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpuic.config import ModelConfig, OptimConfig
+    from tpuic.data.synthetic import synthetic_batch
+    from tpuic.models import create_model
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+    from tpuic.train.step import make_train_step
+
+    n_chips = jax.device_count()
+    global_batch = per_chip_batch * n_chips
+    size = 224
+    mcfg = ModelConfig(name="resnet50", num_classes=1000, dtype="bfloat16",
+                       remat=remat)
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                      milestones=())
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype)
+    state = create_train_state(model, make_optimizer(ocfg), jax.random.key(0),
+                               (global_batch, size, size, 3))
+    batch = synthetic_batch(global_batch, size, mcfg.num_classes)
+    batch = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.items()}
+    step = make_train_step(ocfg, mcfg, None, donate=True)
+
+    lowered = step.lower(state, batch)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    flops_per_step = float(cost["flops"])
+    t_comp = time.perf_counter()
+    state, m = step(state, batch)
+    float(m["loss"])
+    compile_s = time.perf_counter() - t_comp
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    step_ms = 1000 * dt / n_steps
+    imgs = global_batch * n_steps / dt
+    mfu = flops_per_step * (n_steps / dt) / (PEAK_BF16 * n_chips)
+    mem = compiled.memory_analysis()
+    out = {
+        "per_chip_batch": per_chip_batch,
+        "remat": remat,
+        "step_ms": round(step_ms, 2),
+        "images_per_sec_per_chip": round(imgs / n_chips, 1),
+        "mfu": round(mfu, 4),
+        "flops_per_step": flops_per_step,
+        "flops_per_image": round(flops_per_step / global_batch / 1e9, 2),
+        "compile_s": round(compile_s, 1),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+    }
+    if mem is not None:
+        out["peak_memory_mb"] = round(
+            getattr(mem, "temp_size_in_bytes", 0) / 1e6, 1)
+        out["argument_mb"] = round(
+            getattr(mem, "argument_size_in_bytes", 0) / 1e6, 1)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="64,128,256")
+    ap.add_argument("--remat", action="store_true",
+                    help="also measure remat=True at each batch size")
+    ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, "tests", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    results = []
+    for b in [int(x) for x in args.batches.split(",")]:
+        for remat in ([False, True] if args.remat else [False]):
+            try:
+                r = measure(b, remat)
+            except Exception as e:  # OOM at large batch is a data point
+                r = {"per_chip_batch": b, "remat": remat,
+                     "error": f"{type(e).__name__}: {e}"[:300]}
+            print(json.dumps(r), flush=True)
+            results.append(r)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump({"device": str(jax.devices()[0]), "results": results}, f,
+                  indent=2)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
