@@ -58,6 +58,23 @@ def _compiler_params():
         return pltpu.TPUCompilerParams(dimension_semantics=_DIM_SEMANTICS)
 
 
+def _dot_precision(dtype) -> jax.lax.Precision:
+    """MXU precision for kernel contractions, by operand dtype.
+
+    bf16 operands are a native single MXU pass — leave the default. f32
+    operands MUST be HIGHEST: the default lowers f32 matmuls to ONE lossy
+    bf16 pass (measured 5e-3 max error on chip, round-3 smoke), which
+    would silently degrade f32 attention."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _f32_for(ref_dtype, x):
+    """Softmax-side f32 view of a probability tile, cast back to the
+    operand dtype only when the MXU pass is narrow anyway."""
+    return x.astype(ref_dtype) if ref_dtype != jnp.float32 else x
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                 block_k: int, scale: float, valid_len: int,
                 n_k_blocks: int):
@@ -75,12 +92,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         l_s[...] = jnp.zeros_like(l_s)
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    bq = q.shape[0]
-    kj = k_ref[0].astype(jnp.float32)                    # [bk, D]
-    vj = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # [bq, bk]
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    # Contract in the operands' stored dtype (bf16 stays one native MXU
+    # pass; f32 runs HIGHEST — see _dot_precision); scale the f32 result.
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale      # [bq, bk]
+    bq = s.shape[0]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
     s = jnp.where(kpos < valid_len, s, _NEG_INF)
@@ -92,7 +111,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     alpha = jnp.exp(m - m_new)
     l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_s[...] = acc_s[...] * alpha + jnp.dot(
-        p, vj, preferred_element_type=jnp.float32)
+        _f32_for(dt, p), v_ref[0], preferred_element_type=jnp.float32,
+        precision=prec)
     m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
     l_s[...] = jnp.broadcast_to(l, l_s.shape)
 
@@ -104,7 +124,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         if lse_ref is not None:
             # logsumexp per query row, the only softmax residual the backward
             # needs. Fully-masked (padded-q) rows get a finite sentinel.
-            lse_ref[0] = jnp.where(
+            # lse blocks are [1, 1, block_q]: row vectors must keep a
+            # unit second-minor dim — Mosaic requires the last two block
+            # dims to be (mult of 8, mult of 128) OR equal to the array
+            # dims, which a [1, block_q] block of a 2D array violates
+            # (surfaced on real TPU, round-3 smoke; interpret mode did
+            # not enforce it).
+            lse_ref[0, 0] = jnp.where(
                 mf[:, 0] > _NEG_INF / 2,
                 mf[:, 0] + jnp.log(jnp.maximum(lf[:, 0], 1e-30)), 0.0)
 
@@ -151,8 +177,12 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
     out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
                               memory_space=pltpu.VMEM)]
     if with_lse:
-        out_shape.append(jax.ShapeDtypeStruct((b * h, n_padded), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, block_q), lambda i, j, ki: (i, j),
+        # [B*H, 1, N_padded]: the unit middle dim makes the block's last two
+        # dims (1, block_q) = (full array dim, lane multiple) — TPU-legal.
+        out_shape.append(jax.ShapeDtypeStruct((b * h, 1, n_padded),
+                                              jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, block_q),
+                                      lambda i, j, ki: (i, 0, j),
                                       memory_space=pltpu.VMEM))
 
     def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
@@ -202,23 +232,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)                     # [bq, D]
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                            # [bq, 1]
-    delta = delta_ref[0][:, None]
-    bq = q.shape[0]
-    kj = k_ref[0].astype(jnp.float32)                    # [bk, D]
-    vj = v_ref[0].astype(jnp.float32)
-    s = scale * jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    lse = lse_ref[0, 0][:, None]                         # [bq, 1]
+    delta = delta_ref[0, 0][:, None]
+    s = scale * jax.lax.dot_general(q_ref[0], k_ref[0],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+    bq = s.shape[0]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
     s = jnp.where(kpos < valid_len, s, _NEG_INF)
     p = jnp.exp(s - lse)                                 # [bq, bk]
-    dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
     ds = p * (dp - delta)
-    acc_s[...] += jnp.dot(ds, kj, preferred_element_type=jnp.float32)
+    acc_s[...] += jnp.dot(_f32_for(dt, ds), k_ref[0],
+                          preferred_element_type=jnp.float32,
+                          precision=prec)
 
     @pl.when(ki == n_k_blocks - 1)
     def _finish():
@@ -237,28 +270,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_s[...] = jnp.zeros_like(dk_s)
         dv_s[...] = jnp.zeros_like(dv_s)
 
-    kb = k_ref[0].astype(jnp.float32)                    # [bk, D]
-    vb = v_ref[0].astype(jnp.float32)
-    bk = kb.shape[0]
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    bk = k_ref.shape[1]
     j = pl.program_id(1)
     kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # [1, bk]
 
-    qi = q_ref[0].astype(jnp.float32)                    # [bq, D]
-    doi = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    s = scale * jax.lax.dot_general(qi, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    s = scale * jax.lax.dot_general(q_ref[0], k_ref[0],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
     s = jnp.where(kpos < valid_len, s, _NEG_INF)         # [bq, bk]
     p = jnp.exp(s - lse)
-    dv_s[...] += jax.lax.dot_general(p, doi, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(doi, vb, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+    dv_s[...] += jax.lax.dot_general(_f32_for(dt, p), do_ref[0],
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32,
+                                     precision=prec)
+    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
     ds = p * (dp - delta)                                # [bq, bk]
     dk_s[...] += scale * jax.lax.dot_general(
-        ds, qi, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        _f32_for(dt, ds), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec)
 
     @pl.when(qi_idx == n_q_blocks - 1)
     def _finish():
@@ -271,7 +308,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
                interpret: bool):
     """Blockwise backward: (dq, dk, dv), each [B, N, H, D]. lse is the folded
-    [B*H, N_padded] logsumexp saved by the forward."""
+    [B*H, 1, N_padded] logsumexp saved by the forward."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_padded = _padded_len(n, block_q, block_k)
@@ -279,7 +316,10 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
     qf, kf, vf, of, dof = (_fold(t, b, h, n, d, n_padded)
                            for t in (q, k, v, o, do))
     # delta_i = rowsum(do_i * o_i): the softmax-jacobian correction term.
-    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    # Kept [B*H, 1, N_padded] like lse (see the TPU block-shape note in
+    # _fwd_kernel).
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)[:, None, :]
     n_q_blocks = n_padded // block_q
     n_k_blocks = n_padded // block_k
 
@@ -289,9 +329,11 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
                                    memory_space=pltpu.VMEM)
     red = lambda bsz: pl.BlockSpec((1, bsz, d), lambda i, j, r: (i, r, 0),
                                    memory_space=pltpu.VMEM)
-    row_own = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j, r: (i, j),
+    row_own = lambda bsz: pl.BlockSpec((1, 1, bsz),
+                                       lambda i, j, r: (i, 0, j),
                                        memory_space=pltpu.VMEM)
-    row_red = lambda bsz: pl.BlockSpec((1, bsz), lambda i, j, r: (i, r),
+    row_red = lambda bsz: pl.BlockSpec((1, 1, bsz),
+                                       lambda i, j, r: (i, 0, r),
                                        memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
