@@ -24,6 +24,8 @@ import jax.numpy as jnp  # noqa: E402
 from tpuic.checkpoint.manager import lenient_restore  # noqa: E402
 from tpuic.checkpoint.torch_convert import (  # noqa: E402
     convert_efficientnet, convert_inception, convert_state_dict, detect_arch)
+from tpuic.checkpoint.torch_ref import (  # noqa: E402
+    build_efficientnet, build_inception)
 from tpuic.models import create_model  # noqa: E402
 
 
@@ -35,198 +37,9 @@ def _randomize_bn(model):
                 m.running_var.uniform_(0.5, 1.5)
 
 
-def _reference_mlp_head(in_features, num_classes):
-    # reference nn/classifier.py:26-34: in->128->64->32->n with ReLU
-    return tnn.Sequential(
-        tnn.Linear(in_features, 128), tnn.ReLU(),
-        tnn.Linear(128, 64), tnn.ReLU(),
-        tnn.Linear(64, 32), tnn.ReLU(),
-        tnn.Linear(32, num_classes))
-
-
-# ---------------------------------------------------------------------------
-# Inception-v3 torch replica (torchvision module naming)
-# ---------------------------------------------------------------------------
-
-class BasicConv2d(tnn.Module):
-    def __init__(self, inp, out, **kw):
-        super().__init__()
-        self.conv = tnn.Conv2d(inp, out, bias=False, **kw)
-        self.bn = tnn.BatchNorm2d(out, eps=0.001)
-
-    def forward(self, x):
-        return F.relu(self.bn(self.conv(x)))
-
-
-class TorchInceptionA(tnn.Module):
-    def __init__(self, inp, pool_features):
-        super().__init__()
-        self.branch1x1 = BasicConv2d(inp, 64, kernel_size=1)
-        self.branch5x5_1 = BasicConv2d(inp, 48, kernel_size=1)
-        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
-        self.branch3x3dbl_1 = BasicConv2d(inp, 64, kernel_size=1)
-        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
-        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
-        self.branch_pool = BasicConv2d(inp, pool_features, kernel_size=1)
-
-    def forward(self, x):
-        b1 = self.branch1x1(x)
-        b5 = self.branch5x5_2(self.branch5x5_1(x))
-        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
-        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
-        return torch.cat([b1, b5, b3, bp], 1)
-
-
-class TorchInceptionB(tnn.Module):
-    def __init__(self, inp):
-        super().__init__()
-        self.branch3x3 = BasicConv2d(inp, 384, kernel_size=3, stride=2)
-        self.branch3x3dbl_1 = BasicConv2d(inp, 64, kernel_size=1)
-        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
-        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
-
-    def forward(self, x):
-        return torch.cat([
-            self.branch3x3(x),
-            self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
-            F.max_pool2d(x, 3, stride=2)], 1)
-
-
-class TorchInceptionC(tnn.Module):
-    def __init__(self, inp, c7):
-        super().__init__()
-        self.branch1x1 = BasicConv2d(inp, 192, kernel_size=1)
-        self.branch7x7_1 = BasicConv2d(inp, c7, kernel_size=1)
-        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7),
-                                       padding=(0, 3))
-        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1),
-                                       padding=(3, 0))
-        self.branch7x7dbl_1 = BasicConv2d(inp, c7, kernel_size=1)
-        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1),
-                                          padding=(3, 0))
-        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7),
-                                          padding=(0, 3))
-        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1),
-                                          padding=(3, 0))
-        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7),
-                                          padding=(0, 3))
-        self.branch_pool = BasicConv2d(inp, 192, kernel_size=1)
-
-    def forward(self, x):
-        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
-        bd = self.branch7x7dbl_1(x)
-        for m in (self.branch7x7dbl_2, self.branch7x7dbl_3,
-                  self.branch7x7dbl_4, self.branch7x7dbl_5):
-            bd = m(bd)
-        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
-        return torch.cat([self.branch1x1(x), b7, bd, bp], 1)
-
-
-class TorchInceptionD(tnn.Module):
-    def __init__(self, inp):
-        super().__init__()
-        self.branch3x3_1 = BasicConv2d(inp, 192, kernel_size=1)
-        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
-        self.branch7x7x3_1 = BasicConv2d(inp, 192, kernel_size=1)
-        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7),
-                                         padding=(0, 3))
-        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1),
-                                         padding=(3, 0))
-        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
-
-    def forward(self, x):
-        b7 = self.branch7x7x3_1(x)
-        for m in (self.branch7x7x3_2, self.branch7x7x3_3, self.branch7x7x3_4):
-            b7 = m(b7)
-        return torch.cat([
-            self.branch3x3_2(self.branch3x3_1(x)), b7,
-            F.max_pool2d(x, 3, stride=2)], 1)
-
-
-class TorchInceptionE(tnn.Module):
-    def __init__(self, inp):
-        super().__init__()
-        self.branch1x1 = BasicConv2d(inp, 320, kernel_size=1)
-        self.branch3x3_1 = BasicConv2d(inp, 384, kernel_size=1)
-        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3),
-                                        padding=(0, 1))
-        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1),
-                                        padding=(1, 0))
-        self.branch3x3dbl_1 = BasicConv2d(inp, 448, kernel_size=1)
-        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
-        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3),
-                                           padding=(0, 1))
-        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1),
-                                           padding=(1, 0))
-        self.branch_pool = BasicConv2d(inp, 192, kernel_size=1)
-
-    def forward(self, x):
-        b3 = self.branch3x3_1(x)
-        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
-        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
-        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
-        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
-        return torch.cat([self.branch1x1(x), b3, bd, bp], 1)
-
-
-class TorchInceptionAux(tnn.Module):
-    def __init__(self, inp, num_classes):
-        super().__init__()
-        self.conv0 = BasicConv2d(inp, 128, kernel_size=1)
-        self.conv1 = BasicConv2d(128, 768, kernel_size=5)
-        self.fc = tnn.Linear(768, num_classes)
-
-    def forward(self, x):
-        x = F.avg_pool2d(x, 5, stride=3)
-        x = self.conv1(self.conv0(x))
-        x = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
-        return self.fc(x)
-
-
-class TorchInceptionV3(tnn.Module):
-    """torchvision-named inception_v3 body + the reference's MLP head."""
-
-    def __init__(self, num_classes=7, aux=True):
-        super().__init__()
-        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
-        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
-        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
-        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
-        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
-        self.Mixed_5b = TorchInceptionA(192, 32)
-        self.Mixed_5c = TorchInceptionA(256, 64)
-        self.Mixed_5d = TorchInceptionA(288, 64)
-        self.Mixed_6a = TorchInceptionB(288)
-        self.Mixed_6b = TorchInceptionC(768, 128)
-        self.Mixed_6c = TorchInceptionC(768, 160)
-        self.Mixed_6d = TorchInceptionC(768, 160)
-        self.Mixed_6e = TorchInceptionC(768, 192)
-        if aux:
-            self.AuxLogits = TorchInceptionAux(768, num_classes)
-        self.Mixed_7a = TorchInceptionD(768)
-        self.Mixed_7b = TorchInceptionE(1280)
-        self.Mixed_7c = TorchInceptionE(2048)
-        self.fc = _reference_mlp_head(2048, num_classes)
-
-    def forward(self, x):
-        x = self.Conv2d_1a_3x3(x)
-        x = self.Conv2d_2a_3x3(x)
-        x = self.Conv2d_2b_3x3(x)
-        x = F.max_pool2d(x, 3, stride=2)
-        x = self.Conv2d_3b_1x1(x)
-        x = self.Conv2d_4a_3x3(x)
-        x = F.max_pool2d(x, 3, stride=2)
-        for name in ("Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a",
-                     "Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e",
-                     "Mixed_7a", "Mixed_7b", "Mixed_7c"):
-            x = getattr(self, name)(x)
-        x = x.mean(dim=(2, 3))
-        return self.fc(x)
-
-
 def test_inception_forward_parity():
     torch.manual_seed(4)
-    tm = TorchInceptionV3(num_classes=7).eval()
+    tm = build_inception(num_classes=7).eval()
     _randomize_bn(tm)
     x = np.random.default_rng(5).normal(
         size=(2, 128, 128, 3)).astype(np.float32)
@@ -254,7 +67,7 @@ def test_inception_aux_conversion_shapes():
     forward needs 299px inputs — too heavy for CPU CI; the aux loss path is
     covered functionally by test_loss/test_train_step)."""
     torch.manual_seed(6)
-    tm = TorchInceptionV3(num_classes=7)
+    tm = build_inception(num_classes=7)
     tree = convert_inception(tm.state_dict())
     aux = tree["params"]["backbone"]["aux"]
     assert aux["conv0"]["conv"]["kernel"].shape == (1, 1, 768, 128)
@@ -269,97 +82,9 @@ def test_inception_aux_conversion_shapes():
 # TF-style SAME padding)
 # ---------------------------------------------------------------------------
 
-class SameConv2d(tnn.Conv2d):
-    """Conv2dDynamicSamePadding: TF SAME semantics (asymmetric pad)."""
-
-    def forward(self, x):
-        ih, iw = x.shape[-2:]
-        kh, kw = self.weight.shape[-2:]
-        sh, sw = self.stride
-        ph = max((math.ceil(ih / sh) - 1) * sh + kh - ih, 0)
-        pw = max((math.ceil(iw / sw) - 1) * sw + kw - iw, 0)
-        x = F.pad(x, [pw // 2, pw - pw // 2, ph // 2, ph - ph // 2])
-        return F.conv2d(x, self.weight, self.bias, self.stride, 0,
-                        self.dilation, self.groups)
-
-
-def _swish(x):
-    return x * torch.sigmoid(x)
-
-
-class TorchMBConv(tnn.Module):
-    def __init__(self, inp, out, expand, kernel, stride):
-        super().__init__()
-        mid = inp * expand
-        self.has_expand = expand != 1
-        if self.has_expand:
-            self._expand_conv = SameConv2d(inp, mid, 1, bias=False)
-            self._bn0 = tnn.BatchNorm2d(mid, eps=1e-3)
-        self._depthwise_conv = SameConv2d(mid, mid, kernel, stride=stride,
-                                          groups=mid, bias=False)
-        self._bn1 = tnn.BatchNorm2d(mid, eps=1e-3)
-        se_ch = max(1, int(inp * 0.25))
-        self._se_reduce = SameConv2d(mid, se_ch, 1)
-        self._se_expand = SameConv2d(se_ch, mid, 1)
-        self._project_conv = SameConv2d(mid, out, 1, bias=False)
-        self._bn2 = tnn.BatchNorm2d(out, eps=1e-3)
-        self.skip = stride == 1 and inp == out
-
-    def forward(self, x):
-        y = x
-        if self.has_expand:
-            y = _swish(self._bn0(self._expand_conv(y)))
-        y = _swish(self._bn1(self._depthwise_conv(y)))
-        s = F.adaptive_avg_pool2d(y, 1)
-        s = self._se_expand(_swish(self._se_reduce(s)))
-        y = torch.sigmoid(s) * y
-        y = self._bn2(self._project_conv(y))
-        return y + x if self.skip else y
-
-
-# (expand, channels, repeats, stride, kernel) — B0
-_B0_BLOCKS = ((1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
-              (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
-              (6, 320, 1, 1, 3))
-
-
-class TorchEfficientNetB0(tnn.Module):
-    """efficientnet_pytorch-named B0 body + the reference's intended head.
-
-    The reference's efficientnet branch is broken upstream
-    (nn/classifier.py:17-18+27 sets ``.fc`` on a model whose attr is
-    ``._fc``); the package's own single-Linear ``_fc`` is used here, which
-    maps to ``head/out``.
-    """
-
-    def __init__(self, num_classes=7):
-        super().__init__()
-        self._conv_stem = SameConv2d(3, 32, 3, stride=2, bias=False)
-        self._bn0 = tnn.BatchNorm2d(32, eps=1e-3)
-        blocks = []
-        inp = 32
-        for expand, ch, repeats, stride, kernel in _B0_BLOCKS:
-            for r in range(repeats):
-                blocks.append(TorchMBConv(inp, ch, expand, kernel,
-                                          stride if r == 0 else 1))
-                inp = ch
-        self._blocks = tnn.ModuleList(blocks)
-        self._conv_head = SameConv2d(320, 1280, 1, bias=False)
-        self._bn1 = tnn.BatchNorm2d(1280, eps=1e-3)
-        self._fc = tnn.Linear(1280, num_classes)
-
-    def forward(self, x):
-        x = _swish(self._bn0(self._conv_stem(x)))
-        for b in self._blocks:
-            x = b(x)
-        x = _swish(self._bn1(self._conv_head(x)))
-        x = F.adaptive_avg_pool2d(x, 1).flatten(1)
-        return self._fc(x)
-
-
 def test_efficientnet_forward_parity():
     torch.manual_seed(7)
-    tm = TorchEfficientNetB0(num_classes=7).eval()
+    tm = build_efficientnet('b0', num_classes=7).eval()
     _randomize_bn(tm)
     x = np.random.default_rng(8).normal(size=(2, 64, 64, 3)).astype(np.float32)
     with torch.no_grad():
@@ -398,7 +123,7 @@ def test_detect_arch():
 
 def test_convert_state_dict_dispatch():
     torch.manual_seed(9)
-    tm = TorchEfficientNetB0(num_classes=7)
+    tm = build_efficientnet('b0', num_classes=7)
     tree = convert_state_dict(tm.state_dict(), arch="efficientnet-b0")
     assert "stem_conv" in tree["params"]["backbone"]
     tree2 = convert_state_dict(tm.state_dict())  # auto-detect
@@ -408,7 +133,7 @@ def test_convert_state_dict_dispatch():
 def test_detect_efficientnet_variant():
     from tpuic.checkpoint.torch_convert import detect_efficientnet_variant
     torch.manual_seed(10)
-    tm = TorchEfficientNetB0(num_classes=7)
+    tm = build_efficientnet('b0', num_classes=7)
     assert detect_efficientnet_variant(tm.state_dict()) == "b0"
     # auto-detected conversion picks the right variant: all backbone keys map
     tree = convert_state_dict(tm.state_dict())

@@ -111,6 +111,29 @@ def test_eval_step_exact_counts(devices8):
     assert 0.0 <= float(m["correct"]) <= 10.0
 
 
+def test_eval_step_per_sample_wrong_vector_is_global(devices8):
+    """per_sample=True returns the GLOBAL misclassification vector,
+    replicated (GSPMD all-gathers it over the data axis) — the fixed-shape
+    redesign of the reference's ragged pickle all_gather
+    (ddp_utils.py:16-56)."""
+    mesh = make_mesh(MeshConfig(), devices8)
+    state = _state()
+    estep = make_eval_step(OCFG, MCFG, mesh=mesh, per_sample=True)
+    batch = synthetic_batch(16, 32, 3)
+    batch["mask"] = np.array([1.0] * 12 + [0.0] * 4, np.float32)
+    m = estep(state, batch)
+    wrong = np.asarray(m["wrong"])
+    assert wrong.shape == (16,)
+    assert m["wrong"].sharding.is_fully_replicated
+    # padded rows can never be counted wrong; the sums are consistent
+    assert np.all(wrong[12:] == 0.0)
+    assert float(np.sum(wrong)) == 12.0 - float(m["correct"])
+    # single-device path agrees
+    single = make_eval_step(OCFG, MCFG, mesh=None, per_sample=True)(
+        _state(), {k: jnp.asarray(v) for k, v in batch.items()})
+    np.testing.assert_allclose(np.asarray(single["wrong"]), wrong)
+
+
 def test_remat_step_matches_plain_step():
     """remat must change memory behavior, never numerics."""
     state = _state()

@@ -51,10 +51,10 @@ def test_init_from_torch_checkpoint(imagefolder, tmp_path, devices8):
     (reference starts every backbone pretrained, nn/classifier.py:9-21)."""
     torch = pytest.importorskip("torch")
     import numpy as np
-    from tests.test_torch_convert import TorchResNet18
+    from tpuic.checkpoint.torch_ref import build_resnet
 
     torch.manual_seed(11)
-    tm = TorchResNet18(num_classes=3)
+    tm = build_resnet("resnet18", num_classes=3)
     ckpt = str(tmp_path / "best_model")
     torch.save({"epoch": 7, "best_score": 66.0,
                 "state_dict": {f"module.encoder.{k}": v

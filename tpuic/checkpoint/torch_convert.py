@@ -404,6 +404,18 @@ def detect_arch(state_dict: Mapping[str, Any]) -> str:
     raise ValueError("could not detect backbone family from state_dict keys")
 
 
+def detect_resnet_depth(state_dict: Mapping[str, Any]) -> str:
+    """'resnet{18,34,50,101}' from block kind + layer3 block count."""
+    flat = strip_prefixes(state_dict)
+    bottleneck = any(k.startswith("layer1.0.conv3") for k in flat)
+    blocks = {int(m.group(1)) for k in flat
+              if (m := re.match(r"layer3\.(\d+)\.", k))}
+    n3 = (max(blocks) + 1) if blocks else 0
+    if bottleneck:
+        return "resnet101" if n3 >= 23 else "resnet50"
+    return "resnet34" if n3 >= 6 else "resnet18"
+
+
 def convert_state_dict(state_dict: Mapping[str, Any],
                        arch: str = "auto", **kw) -> Dict[str, Dict]:
     """Convert any supported torch state_dict to tpuic trees.
@@ -451,3 +463,122 @@ def convert_reference_checkpoint(path: str,
     tree["epoch"] = payload["epoch"]
     tree["best_score"] = payload["best_score"]
     return tree
+
+
+# ---------------------------------------------------------------------------
+# CLI:  python -m tpuic.checkpoint.torch_convert <ckpt> [--verify]
+# ---------------------------------------------------------------------------
+
+def _infer_head(state_dict: Mapping[str, Any]) -> Tuple[int, bool]:
+    """(num_classes, mlp_head) from the checkpoint's own head keys."""
+    flat = strip_prefixes(state_dict)
+    if "fc.6.bias" in flat:       # reference MLP head (fc.0/2/4/6)
+        return int(flat["fc.6.bias"].shape[0]), True
+    for k in ("fc.bias", "_fc.bias"):   # plain torchvision / effnet _fc
+        if k in flat:
+            return int(flat[k].shape[0]), False
+    raise ValueError("cannot infer num_classes: no fc head keys found")
+
+
+def main(argv=None) -> int:
+    """Convert a reference/torchvision checkpoint; optionally verify parity.
+
+    ``--verify`` loads the checkpoint BOTH into a bare-torch replica of the
+    reference Classifier (tpuic/checkpoint/torch_ref.py — exact upstream
+    module naming) and, through the converter + lenient restore, into the
+    tpuic Flax model, then prints the max |Δlogits| on random inputs
+    (SURVEY.md §7 "Checkpoint compatibility"; reference train.py:177-179).
+    """
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuic.checkpoint.torch_convert", description=__doc__)
+    ap.add_argument("checkpoint", help="reference best_model/latest_model "
+                    "file or a bare torch state_dict file")
+    ap.add_argument("--arch", default="auto",
+                    help="backbone family (default: sniffed from keys)")
+    ap.add_argument("--verify", action="store_true",
+                    help="run torch replica vs converted Flax model and "
+                    "print max logits delta")
+    ap.add_argument("--image-size", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--tol", type=float, default=1e-3,
+                    help="--verify failure threshold on max |delta|")
+    args = ap.parse_args(argv)
+
+    payload = load_reference_checkpoint(args.checkpoint)
+    sd = payload["state_dict"]
+    arch = args.arch if args.arch != "auto" else detect_arch(sd)
+    if arch == "efficientnet":
+        arch = f"efficientnet-{detect_efficientnet_variant(sd)}"
+    elif arch == "resnet":
+        arch = detect_resnet_depth(sd)
+    tree = convert_state_dict(sd, arch=arch)
+    n_params = len([1 for _ in _iter_leaves(tree["params"])])
+    n_stats = len([1 for _ in _iter_leaves(tree["batch_stats"])])
+    num_classes, mlp_head = _infer_head(sd)
+    print(json.dumps({
+        "checkpoint": args.checkpoint, "arch": arch,
+        "epoch": payload["epoch"], "best_score": payload["best_score"],
+        "num_classes": num_classes, "mlp_head": mlp_head,
+        "param_leaves": n_params, "batch_stat_leaves": n_stats}))
+    if not args.verify:
+        return 0
+
+    import numpy as np
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuic.checkpoint.manager import lenient_restore
+    from tpuic.checkpoint.torch_ref import build_reference_model
+    from tpuic.models import create_model
+
+    replica = build_reference_model(arch, num_classes).eval()
+    # strip_prefixes normalizes to numpy for the converter; torch's
+    # load_state_dict wants tensors back.
+    stripped = {k: torch.as_tensor(np.asarray(v))
+                for k, v in strip_prefixes(sd).items()}
+    missing, unexpected = replica.load_state_dict(stripped, strict=False)
+    kw = {} if mlp_head else {"head_widths": ()}
+    model = create_model(arch, num_classes, dtype="float32", **kw)
+    size = args.image_size
+    variables = model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)),
+                           train=False)
+    merged_p, n_loaded, n_total = lenient_restore(
+        dict(variables["params"]), tree["params"])
+    merged_s, n_s, n_s_total = lenient_restore(
+        dict(variables["batch_stats"]), tree["batch_stats"])
+    x = np.random.default_rng(0).normal(
+        size=(args.batch, size, size, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = replica(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(model.apply({"params": merged_p,
+                                  "batch_stats": merged_s},
+                                 jnp.asarray(x), train=False))
+    delta = float(np.abs(got - want).max())
+    ok = (delta < args.tol and n_loaded == n_total and n_s == n_s_total
+          and not missing)
+    print(json.dumps({
+        "verify": "ok" if ok else "FAIL",
+        "max_logits_delta": delta,
+        "params_mapped": f"{n_loaded}/{n_total}",
+        "batch_stats_mapped": f"{n_s}/{n_s_total}",
+        "replica_missing_keys": len(missing),
+        "replica_unexpected_keys": len(unexpected)}))
+    return 0 if ok else 1
+
+
+def _iter_leaves(tree):
+    for v in tree.values():
+        if isinstance(v, dict):
+            yield from _iter_leaves(v)
+        else:
+            yield v
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,5 +1,15 @@
-from tpuic.parallel.collectives import (  # noqa: F401
-    pmean_tree, psum_scalar, global_mean, all_gather_batch,
-)
+"""Parallelism strategies beyond data parallel.
+
+The reference's eager NCCL helpers (ddp_utils.py:8-56 — ``reduce_tensor``
+and the pickle-based ragged ``all_gather``) have no standalone equivalent
+here BY DESIGN: under SPMD, collectives are traced into the jitted step
+(grad pmean, SyncBN stat sync, metric reductions — tpuic/train/step.py) and
+the ragged gather is redesigned as fixed-shape global outputs: the
+per-sample correctness vector returned replicated from the sharded eval
+step IS the cross-host all_gather, ridden over ICI by GSPMD
+(make_eval_step(per_sample=True), used by Trainer.val_epoch's
+misclassified-id collection).
+"""
+
 from tpuic.parallel.ring_attention import ring_attention  # noqa: F401
 from tpuic.parallel.ulysses import ulysses_attention  # noqa: F401

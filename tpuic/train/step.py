@@ -127,7 +127,8 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
 
 
 def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
-                   mesh: Optional[Mesh] = None, state_sharding=None) -> Callable:
+                   mesh: Optional[Mesh] = None, state_sharding=None,
+                   per_sample: bool = False) -> Callable:
     """Returns jitted ``eval_step(state, batch) -> metrics``.
 
     metrics: {'correct': Σ 0/1 over valid, 'count': Σ mask,
@@ -137,6 +138,15 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     double-count) and the exact global weighted CE (numerator and
     denominator accumulated separately so batch composition can't skew the
     weighted mean).
+
+    per_sample=True adds ``wrong``: the [global_batch] 0/1
+    misclassification vector. Its output sharding is replicated, so under a
+    mesh GSPMD materializes it with an all-gather over the ``data`` axis —
+    the fixed-shape, ICI-ridden redesign of the reference's pickle-based
+    ragged all_gather of per-sample results (ddp_utils.py:16-56): every
+    host ends up with the full global vector and can map positions back to
+    image ids through the (host-replicated) epoch order
+    (tpuic.data.Loader attaches ``batch.indices``).
     """
     class_weights = (jnp.asarray(optim_cfg.class_weights, jnp.float32)
                      if optim_cfg.class_weights else None)
@@ -158,8 +168,11 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         else:
             w = m
         loss_den = jnp.sum(w)
-        return {"correct": jnp.sum(acc * m), "count": jnp.sum(m),
-                "loss_num": loss * loss_den, "loss_den": loss_den}
+        out = {"correct": jnp.sum(acc * m), "count": jnp.sum(m),
+               "loss_num": loss * loss_den, "loss_den": loss_den}
+        if per_sample:
+            out["wrong"] = (1.0 - acc) * m
+        return out
 
     if mesh is None:
         return jax.jit(eval_step)

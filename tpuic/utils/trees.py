@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 
 def tree_size(tree) -> int:
@@ -14,12 +13,3 @@ def tree_size(tree) -> int:
 def tree_bytes(tree) -> int:
     """Total bytes of a pytree's arrays."""
     return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
-
-
-def cast_floats(tree, dtype):
-    """Cast floating-point leaves of a pytree to dtype, leaving ints alone."""
-    def _cast(x):
-        if jnp.issubdtype(x.dtype, jnp.floating):
-            return x.astype(dtype)
-        return x
-    return jax.tree_util.tree_map(_cast, tree)

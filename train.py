@@ -35,8 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accepted for launch-command compatibility; unused — "
                         "one JAX process drives all local chips")
     # Everything the reference hard-codes (train.py:110-183).
-    p.add_argument("--model", default="resnet50",
-                   help="backbone name (see tpuic.models.available_models())")
+    p.add_argument("--model", default="inceptionv3",
+                   help="backbone name (see tpuic.models.available_models()); "
+                        "default matches the reference's hard-coded "
+                        "'inceptionv3' (train.py:122). The perf-tracking "
+                        "config (BASELINE.md) uses --model resnet50.")
     p.add_argument("--num-classes", type=int, default=0,
                    help="0 = infer from the folder tree")
     p.add_argument("--resize", type=int, default=299)
@@ -60,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "efficientnet_pytorch state_dict); backbone family "
                         "is auto-detected and weights merge leniently")
     p.add_argument("--workers", type=int, default=6)
+    p.add_argument("--no-pack", action="store_true",
+                   help="disable the packed uint8 cache + device-side "
+                        "augmentation; decode every epoch like the reference")
+    p.add_argument("--cache-dir", default="",
+                   help="packed-cache dir (default {datadir}/.tpuic_pack)")
+    p.add_argument("--collect-misclassified", action="store_true",
+                   help="gather misclassified val image ids each epoch "
+                        "(the reference's per-sample all_gather capability)")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--seed", type=int, default=0)
@@ -89,7 +100,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
     weights = () if args.no_class_weights else tuple(args.class_weights)
     return Config(
         data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
-                        batch_size=args.batchsize, num_workers=args.workers),
+                        batch_size=args.batchsize, num_workers=args.workers,
+                        pack=not args.no_pack, cache_dir=args.cache_dir),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
                           remat=args.remat),
@@ -101,6 +113,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
                       init_from=args.init_from,
+                      collect_misclassified=args.collect_misclassified,
                       profile_dir=args.profile_dir, seed=args.seed),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp),
