@@ -23,7 +23,8 @@ sys.path.insert(0, _REPO)
 PEAK_BF16 = 197e12  # TPU v5e
 
 
-def measure(per_chip_batch: int, remat: bool, n_steps: int = 30) -> dict:
+def measure(per_chip_batch: int, remat: bool, n_steps: int = 30,
+            model_name: str = "resnet50") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -37,7 +38,7 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30) -> dict:
     n_chips = jax.device_count()
     global_batch = per_chip_batch * n_chips
     size = 224
-    mcfg = ModelConfig(name="resnet50", num_classes=1000, dtype="bfloat16",
+    mcfg = ModelConfig(name=model_name, num_classes=1000, dtype="bfloat16",
                        remat=remat)
     ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
                       milestones=())
@@ -66,6 +67,7 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30) -> dict:
     mfu = flops_per_step * (n_steps / dt) / (PEAK_BF16 * n_chips)
     mem = compiled.memory_analysis()
     out = {
+        "model": model_name,
         "per_chip_batch": per_chip_batch,
         "remat": remat,
         "step_ms": round(step_ms, 2),
@@ -87,6 +89,7 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="64,128,256")
+    ap.add_argument("--model", default="resnet50")
     ap.add_argument("--remat", action="store_true",
                     help="also measure remat=True at each batch size")
     ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
@@ -102,16 +105,16 @@ def main():
     for b in [int(x) for x in args.batches.split(",")]:
         for remat in ([False, True] if args.remat else [False]):
             try:
-                r = measure(b, remat)
+                r = measure(b, remat, model_name=args.model)
             except Exception as e:  # OOM at large batch is a data point
-                r = {"per_chip_batch": b, "remat": remat,
+                r = {"model": args.model, "per_chip_batch": b, "remat": remat,
                      "error": f"{type(e).__name__}: {e}"[:300]}
             print(json.dumps(r), flush=True)
             results.append(r)
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
-        json.dump({"device": str(jax.devices()[0]), "results": results}, f,
-                  indent=2)
+        json.dump({"device": str(jax.devices()[0]), "model": args.model,
+                   "results": results}, f, indent=2)
     print(f"wrote {args.out}")
 
 

@@ -66,3 +66,37 @@ def test_registry_contains_reference_selectors():
     names = available_models()
     for required in ["resnet18", "resnet50", "resnet101"]:
         assert required in names
+
+
+def test_space_to_depth_stem_matches_standard_resnet50():
+    """resnet50-s2d is the SAME function as resnet50 once the stem kernel
+    is re-indexed (s2d_stem_kernel) — the MLPerf TPU stem optimization is
+    a layout change, not an architecture change."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuic.models.resnet import s2d_stem_kernel
+
+    std = create_model("resnet50", 5, dtype="float32")
+    s2d = create_model("resnet50-s2d", 5, dtype="float32")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                    jnp.float32)
+    v = std.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    p = jax.tree.map(lambda a: a, v["params"])
+    k77 = p["backbone"]["conv1"]["kernel"]
+    assert k77.shape == (7, 7, 3, 64)
+    p["backbone"]["conv1"]["kernel"] = s2d_stem_kernel(k77)
+    out_std = std.apply(v, x, train=False)
+    out_s2d = s2d.apply({"params": p, "batch_stats": v["batch_stats"]}, x,
+                        train=False)
+    np.testing.assert_allclose(np.asarray(out_std), np.asarray(out_s2d),
+                               atol=1e-4)
+
+
+def test_space_to_depth_rejects_odd_input():
+    import jax
+    import jax.numpy as jnp
+
+    s2d = create_model("resnet50-s2d", 5, dtype="float32")
+    with pytest.raises(ValueError, match="even H/W"):
+        s2d.init(jax.random.key(0), jnp.zeros((1, 63, 63, 3)), train=False)

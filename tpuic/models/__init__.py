@@ -87,6 +87,10 @@ def _register_builtins():
     register("resnet50", _rn(_resnet.resnet50))
     register("resnet101", _rn(_resnet.resnet101))
     register("resnet18-cifar", _rn(_resnet.resnet18, small_stem=True))
+    # MLPerf-style space-to-depth stem: identical math to resnet50 (the
+    # 7x7/s2 stem re-indexed as 4x4/s1 on [H/2,W/2,12]), better MXU layout;
+    # convert standard stem weights with models.resnet.s2d_stem_kernel.
+    register("resnet50-s2d", _rn(_resnet.resnet50, space_to_depth=True))
 
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,

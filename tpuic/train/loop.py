@@ -139,6 +139,22 @@ class Trainer:
         from tpuic.checkpoint.torch_convert import convert_reference_checkpoint
 
         tree = convert_reference_checkpoint(path)
+        if self.cfg.model.name.endswith("-s2d"):
+            # The space-to-depth variant is the same network with a
+            # re-indexed stem kernel (models/resnet.py:s2d_stem_kernel) —
+            # pretrained 7x7 stems convert exactly.
+            from tpuic.models.resnet import s2d_stem_kernel
+            conv1 = tree.get("params", {}).get("backbone", {}).get("conv1")
+            kshape = getattr((conv1 or {}).get("kernel"), "shape", None)
+            if kshape is not None and kshape[0] == 7:
+                conv1["kernel"] = np.asarray(
+                    s2d_stem_kernel(np.asarray(conv1["kernel"])))
+            else:
+                # Silent shape-skip in lenient_restore would leave the stem
+                # at random init with no signal — say so.
+                host0_print(f"[init] {path}: no 7x7 stem kernel to convert "
+                            f"for {self.cfg.model.name} (found {kshape}); "
+                            "stem keeps fresh init")
         params, n, total = lenient_restore(
             jax.tree.map(np.asarray, jax.device_get(self.state.params)),
             tree["params"])
