@@ -93,6 +93,9 @@ class ModelConfig:
     remat: bool = False
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
+    # MoE load-balancing loss weight (Switch Transformer's alpha; only
+    # active for *-moe models, which sow 'moe_aux_loss' intermediates).
+    moe_aux_weight: float = 0.01
     # Attention implementation for attention-bearing backbones (ViT):
     # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,
     # tpuic/kernels/flash_attention.py), 'ring' (sequence-parallel ring

@@ -115,6 +115,10 @@ def _register_builtins():
     register("vit-b16", _vit_factory(_vit.vit_b16))
     register("vit-s16", _vit_factory(_vit.vit_s16))
     register("vit-tiny", _vit_factory(_vit.vit_tiny))
+    # Switch-MoE variants (models/moe.py): expert-parallel over the mesh
+    # 'model' axis; beyond-parity (reference is dense-only, SURVEY.md §2c).
+    register("vit-s16-moe", _vit_factory(_vit.vit_s16_moe))
+    register("vit-tiny-moe", _vit_factory(_vit.vit_tiny_moe))
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
              attention, mesh):

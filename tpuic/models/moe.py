@@ -1,0 +1,106 @@
+"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+
+The reference is dense-only (SURVEY.md §2c: "Expert parallel (EP/MoE): No").
+This is a beyond-parity capability, designed the TPU way (GShard/Switch
+lineage): routing and dispatch are pure einsums over STATIC shapes — a
+[T, E, C] one-hot dispatch tensor instead of data-dependent gathers — so the
+whole layer jits, shards, and differentiates like any other matmul stack.
+
+Expert parallelism is a sharding annotation, not a runtime: expert weight
+tensors carry the ``expert`` logical axis (parallel/sharding.py maps it onto
+the mesh ``model`` axis), so each device holds E/ep experts and GSPMD
+inserts the token all-to-alls around the expert contraction. DP/TP/EP
+compose on the same mesh.
+
+Semantics:
+- top-1 routing (Switch Transformer): each token goes to its argmax expert,
+  scaled by the router probability; router math in float32.
+- routing GROUPS are batch rows (GShard convention): capacity and the
+  dispatch one-hots are per image, C = ceil(capacity_factor * N / E), so
+  the dispatch tensor is [B, N, E, C] — linear in batch size. A single
+  global group would make it ~capacity_factor*T^2/E elements, ~13 GB at
+  vit-s16-moe's batch-256 scale.
+- tokens over capacity are DROPPED (contribute zero; the transformer's
+  residual carries them through unchanged) — the standard static-shape
+  trade.
+- load-balancing auxiliary loss (Switch eq. 4): E * sum_e f_e * p_e over
+  all tokens, sown to the 'intermediates' collection as 'moe_aux_loss';
+  the train step adds ModelConfig.moe_aux_weight times its mean to the
+  task loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class SwitchMoEMlp(nn.Module):
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 deterministic: bool = True) -> jnp.ndarray:
+        B, N, D = x.shape
+        E = self.num_experts
+        H = D * self.mlp_ratio
+        C = int(np.ceil(self.capacity_factor * N / E))
+
+        # Router in f32 (tiny; numerically load-bearing).
+        router_kernel = self.param(
+            "router", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "unsharded")),
+            (D, E), self.param_dtype)
+        logits = jnp.einsum("bnd,de->bne", x.astype(jnp.float32),
+                            router_kernel.astype(jnp.float32))
+        probs = nn.softmax(logits, axis=-1)             # [B, N, E] f32
+        gate = jnp.max(probs, axis=-1)                  # [B, N]
+        expert_idx = jnp.argmax(probs, axis=-1)         # [B, N]
+        onehot = nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [B, N, E]
+
+        # Queue position within the (batch-row) group; one_hot of the
+        # 0-based slot is all-zero both for unrouted (-1) and over-capacity
+        # (>= C) tokens, which IS the drop mask.
+        pos = jnp.cumsum(onehot, axis=1) * onehot       # [B, N, E], 1-based
+        disp = nn.one_hot((pos - 1.0).astype(jnp.int32), C,
+                          dtype=jnp.float32)            # [B, N, E, C]
+
+        # Load-balancing aux loss (Switch eq. 4) over all tokens.
+        frac = jnp.mean(onehot, axis=(0, 1))            # [E]
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(frac * mean_prob))
+
+        w1 = self.param("w1", nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), ("expert", "embed", "unsharded")),
+            (E, D, H), self.param_dtype)
+        b1 = self.param("b1", nn.with_logical_partitioning(
+            nn.initializers.zeros, ("expert", "unsharded")),
+            (E, H), self.param_dtype)
+        w2 = self.param("w2", nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), ("expert", "unsharded", "embed")),
+            (E, H, D), self.param_dtype)
+        b2 = self.param("b2", nn.with_logical_partitioning(
+            nn.initializers.zeros, ("expert", "embed")),
+            (E, D), self.param_dtype)
+
+        dt = self.dtype
+        # Dispatch -> per-expert token blocks [B, E, C, D]; GSPMD turns the
+        # resharding from batch-sharded to expert-sharded into all-to-alls
+        # over the mesh when 'expert' is mapped.
+        expert_in = jnp.einsum("bnec,bnd->becd", disp.astype(dt),
+                               x.astype(dt))
+        h = jnp.einsum("becd,edh->bech", expert_in, w1.astype(dt))
+        h = nn.gelu(h + b1.astype(dt)[None, :, None, :])
+        out = jnp.einsum("bech,ehd->becd", h, w2.astype(dt))
+        out = out + b2.astype(dt)[None, :, None, :]
+
+        combine = (disp * gate[..., None, None]).astype(dt)  # [B, N, E, C]
+        return jnp.einsum("bnec,becd->bnd", combine, out)

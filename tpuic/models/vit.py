@@ -98,6 +98,9 @@ class EncoderBlock(nn.Module):
     param_dtype: Any = jnp.float32
     attention: str = "dense"
     mesh: Any = None
+    # >0 replaces this block's dense MLP with a Switch MoE of that many
+    # experts (models/moe.py) — expert-parallel over the mesh 'model' axis.
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -112,11 +115,17 @@ class EncoderBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
-        y = _dense(d * self.mlp_ratio, "mlp_up", self.dtype, self.param_dtype,
-                   ("embed", "model"))(y)
-        y = nn.gelu(y)
-        y = _dense(d, "mlp_down", self.dtype, self.param_dtype,
-                   ("model", "embed"))(y)
+        if self.moe_experts:
+            from tpuic.models.moe import SwitchMoEMlp
+            y = SwitchMoEMlp(self.moe_experts, self.mlp_ratio,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             name="moe")(y, deterministic)
+        else:
+            y = _dense(d * self.mlp_ratio, "mlp_up", self.dtype,
+                       self.param_dtype, ("embed", "model"))(y)
+            y = nn.gelu(y)
+            y = _dense(d, "mlp_down", self.dtype, self.param_dtype,
+                       ("model", "embed"))(y)
         if self.dropout:
             y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
         return x + y
@@ -135,6 +144,10 @@ class ViT(nn.Module):
     param_dtype: Any = jnp.float32
     attention: str = "dense"
     mesh: Any = None
+    # MoE: every ``moe_every``-th block (odd blocks, GShard/Switch
+    # convention) uses a SwitchMoEMlp with ``moe_experts`` experts.
+    moe_experts: int = 0
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -152,9 +165,12 @@ class ViT(nn.Module):
                          (1, x.shape[1], self.hidden), self.param_dtype)
         x = x + pos.astype(self.dtype)
         for i in range(self.depth):
+            moe = (self.moe_experts
+                   if self.moe_experts
+                   and i % self.moe_every == self.moe_every - 1 else 0)
             x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
                              self.dtype, self.param_dtype, self.attention,
-                             self.mesh,
+                             self.mesh, moe,
                              name=f"block{i}")(x, deterministic=not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)
@@ -172,3 +188,14 @@ def vit_s16(**kw) -> ViT:
 def vit_tiny(**kw) -> ViT:
     """Test-scale ViT (fast CI)."""
     return ViT(patch=4, hidden=64, depth=2, num_heads=4, **kw)
+
+
+def vit_s16_moe(**kw) -> ViT:
+    """ViT-S/16 with 8-expert Switch MoE in every other block."""
+    return ViT(patch=16, hidden=384, depth=12, num_heads=6, moe_experts=8,
+               **kw)
+
+
+def vit_tiny_moe(**kw) -> ViT:
+    """Test-scale MoE ViT (fast CI; 4 experts, MoE in block 1)."""
+    return ViT(patch=4, hidden=64, depth=2, num_heads=4, moe_experts=4, **kw)

@@ -38,6 +38,12 @@ def logical_rules(tp: bool, fsdp: bool):
         ("batch", "data"),
         ("embed", "data" if fsdp else None),
         ("model", "model" if tp else None),
+        # Expert parallelism (models/moe.py): expert weight tensors shard
+        # their leading E dim over the mesh 'model' axis — each device holds
+        # E/ep experts; GSPMD inserts the token all-to-alls around the
+        # expert einsums. 'unsharded' marks dims that must stay whole.
+        ("expert", "model" if tp else None),
+        ("unsharded", None),
     )
 
 

@@ -42,6 +42,16 @@ def _batch_shardings(mesh: Mesh):
     return NamedSharding(mesh, P("data"))
 
 
+def _moe_aux_losses(intermediates) -> list:
+    """All 'moe_aux_loss' scalars sown anywhere in the model
+    (models/moe.py); flax sow stores tuples of appended values."""
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        if any(getattr(k, "key", None) == "moe_aux_loss" for k in path):
+            out.append(leaf)
+    return out
+
+
 def _replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
@@ -75,8 +85,10 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
 
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}
+            # 'intermediates' carries sown MoE load-balancing losses
+            # (models/moe.py); empty for dense models.
             return state.apply_fn(variables, images, train=True,
-                                  mutable=["batch_stats"],
+                                  mutable=["batch_stats", "intermediates"],
                                   rngs={"dropout": rng})
 
         if model_cfg.remat:
@@ -95,6 +107,10 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                                        label_smoothing=smoothing,
                                        impl="fused" if optim_cfg.fused_loss
                                        else "reference", mesh=mesh)
+            moe_losses = _moe_aux_losses(mutated.get("intermediates", {}))
+            if moe_losses and model_cfg.moe_aux_weight:
+                loss = loss + model_cfg.moe_aux_weight * (
+                    sum(moe_losses) / len(moe_losses))
             logits = out[0] if isinstance(out, tuple) else out
             return loss, (mutated.get("batch_stats", state.batch_stats), logits)
 
