@@ -26,13 +26,14 @@ OCFG = OptimConfig(optimizer="sgd", learning_rate=0.01, class_weights=(),
                    milestones=())
 
 
-def _layer_apply(capacity_factor, x, seed=0):
+def _layer_apply(capacity_factor, x, seed=0, mask=None):
+    from tpuic.models.moe import switch_aux_loss
     layer = SwitchMoEMlp(num_experts=4, mlp_ratio=2,
                          capacity_factor=capacity_factor)
     v = layer.init(jax.random.key(seed), x)
     y, mut = layer.apply(v, x, mutable=["intermediates"])
-    aux = jax.tree_util.tree_leaves(mut["intermediates"])[0]
-    return y, float(aux)
+    probs, onehot = jax.tree_util.tree_leaves(mut["intermediates"])
+    return y, float(switch_aux_loss(probs, onehot, mask))
 
 
 def test_moe_layer_shapes_and_aux():
@@ -42,6 +43,18 @@ def test_moe_layer_shapes_and_aux():
     assert y.shape == x.shape
     # Balanced routing drives the Switch aux loss toward 1.0 from above.
     assert np.isfinite(aux) and aux >= 1.0 - 1e-3
+
+
+def test_moe_aux_loss_respects_padding_mask():
+    """Wrap-padded duplicate samples (mask=0) must not skew the router's
+    load-balancing statistics."""
+    rng = np.random.default_rng(7)
+    real = rng.normal(size=(3, 8, 16)).astype(np.float32)
+    padded = np.concatenate([real, real[:1]], axis=0)  # duplicate row, B=4
+    mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    _, aux_masked = _layer_apply(1.25, jnp.asarray(padded), mask=mask)
+    _, aux_real = _layer_apply(1.25, jnp.asarray(real))
+    np.testing.assert_allclose(aux_masked, aux_real, rtol=1e-6)
 
 
 def test_moe_capacity_drops_tokens():

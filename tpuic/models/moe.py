@@ -23,9 +23,10 @@ Semantics:
 - tokens over capacity are DROPPED (contribute zero; the transformer's
   residual carries them through unchanged) — the standard static-shape
   trade.
-- load-balancing auxiliary loss (Switch eq. 4): E * sum_e f_e * p_e over
-  all tokens, sown to the 'intermediates' collection as 'moe_aux_loss';
-  the train step adds ModelConfig.moe_aux_weight times its mean to the
+- load-balancing auxiliary loss (Switch eq. 4): the layer sows its router
+  stats ('moe_router' in the 'intermediates' collection); the train step
+  computes ``switch_aux_loss`` with the batch padding mask applied and
+  adds ModelConfig.moe_aux_weight times the mean over MoE layers to the
   task loss.
 """
 
@@ -36,6 +37,27 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
+
+
+def switch_aux_loss(probs: jnp.ndarray, onehot: jnp.ndarray,
+                    mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Switch Transformer load-balancing loss (eq. 4): E * sum_e f_e * p_e.
+
+    probs/onehot: [B, N, E] router softmax and top-1 one-hot (sown by
+    SwitchMoEMlp as 'moe_router'). ``mask``: optional [B] validity (the
+    Loader's padding mask) — masked samples contribute to neither the
+    routed-token fractions nor the mean probabilities.
+    """
+    E = probs.shape[-1]
+    if mask is None:
+        frac = jnp.mean(onehot, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+    else:
+        w = mask.astype(jnp.float32)[:, None, None]          # [B,1,1]
+        denom = jnp.maximum(jnp.sum(w) * probs.shape[1], 1.0)
+        frac = jnp.sum(onehot * w, axis=(0, 1)) / denom
+        mean_prob = jnp.sum(probs * w, axis=(0, 1)) / denom
+    return E * jnp.sum(frac * mean_prob)
 
 
 class SwitchMoEMlp(nn.Module):
@@ -72,11 +94,12 @@ class SwitchMoEMlp(nn.Module):
         disp = nn.one_hot((pos - 1.0).astype(jnp.int32), C,
                           dtype=jnp.float32)            # [B, N, E, C]
 
-        # Load-balancing aux loss (Switch eq. 4) over all tokens.
-        frac = jnp.mean(onehot, axis=(0, 1))            # [E]
-        mean_prob = jnp.mean(probs, axis=(0, 1))
-        self.sow("intermediates", "moe_aux_loss",
-                 E * jnp.sum(frac * mean_prob))
+        # Router stats for the load-balancing aux loss. The loss itself is
+        # computed OUTSIDE the layer (train/step.py via switch_aux_loss) so
+        # the batch padding mask can exclude wrapped duplicate samples —
+        # the layer has no access to the mask, and an unmasked aux would
+        # double-weight padded rows in f_e/p_e (round-3 review finding).
+        self.sow("intermediates", "moe_router", (probs, onehot))
 
         w1 = self.param("w1", nn.with_logical_partitioning(
             nn.initializers.xavier_uniform(), ("expert", "embed", "unsharded")),

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe microbatch scheduling as ONE SPMD program.
+
+The reference has no pipeline parallelism (SURVEY.md §2c: "PP: No" — its
+model is a single-module forward). This is the TPU-native construction:
+instead of a runtime that shuttles activations between stage processes
+(GPipe's original design), the whole pipeline is a single jitted program
+over a ``stage`` mesh axis —
+
+- stage s's parameters live on mesh slice s (leaves stacked [S, ...] and
+  sharded ``P('stage')``);
+- microbatches enter at stage 0 and flow stage-to-stage via
+  ``lax.ppermute`` (neighbor ICI hops) inside a ``fori_loop`` running the
+  classic GPipe schedule of M + S - 1 ticks with bubble steps masked;
+- the loop is differentiable, so ``jax.grad`` of a loss through
+  ``pipeline_apply`` yields exactly the backward pipeline (reverse
+  schedule) without any hand-written scheduling code.
+
+This composes with the other axes: the microbatch dim can itself be
+data-sharded, and stage params can carry TP/EP logical axes. Capability is
+proven against a sequential reference in tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
+                   mesh: Mesh, axis: str = "stage",
+                   x_spec: P = P()) -> jnp.ndarray:
+    """Run ``x`` through S pipeline stages with GPipe microbatching.
+
+    stage_fn: (params_one_stage, mb) -> mb — one stage's computation; the
+        microbatch shape is the same on both sides (transformer-block
+        style).
+    stage_params: pytree whose leaves are stacked [S, ...] and sharded
+        ``P(axis)`` over the mesh (stage s owns slice s).
+    x: [M, mb, ...] microbatches, replicated over the stage axis. To
+        compose with data parallelism pass ``x_spec`` sharding the
+        microbatch (or later) dims over other mesh axes, e.g.
+        ``P(None, 'data')`` on a ('data', 'stage') mesh — the pipeline
+        then runs on each data shard's slice and outputs keep ``x_spec``.
+
+    Returns [M, mb, ...] outputs, replicated over the stage axis (sharded
+    per ``x_spec`` elsewhere).
+    """
+    if x_spec and axis in jax.tree_util.tree_leaves(tuple(x_spec)):
+        raise ValueError(f"x_spec {x_spec} must not use the pipeline axis "
+                         f"'{axis}' — microbatches are replicated over it")
+    S = mesh.shape[axis]
+    M = x.shape[0]
+
+    def worker(params, xs):
+        # Local [1, ...] slice of every stacked leaf -> this stage's params.
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        mb = jnp.zeros(xs.shape[1:], xs.dtype)
+        outs = jnp.zeros_like(xs)
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(t, carry):
+            mb, outs = carry
+            # Stage 0 ingests microbatch t (a dummy repeat during drain
+            # ticks — masked out at write time); others take the handoff.
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), keepdims=False)
+            inp = jnp.where(idx == 0, feed, mb)
+            y = stage_fn(local, inp)
+            # The last stage finishes microbatch t-(S-1) at tick t.
+            pos = t - (S - 1)
+            prev = jax.lax.dynamic_index_in_dim(
+                outs, jnp.clip(pos, 0, M - 1), keepdims=False)
+            write = (idx == S - 1) & (pos >= 0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, y, prev), jnp.clip(pos, 0, M - 1), 0)
+            # Hand y to the next stage (the wrap edge S-1 -> 0 carries a
+            # value stage 0 ignores).
+            mb = jax.lax.ppermute(y, axis, fwd)
+            return mb, outs
+
+        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (mb, outs))
+        # Only the last stage holds real outputs; psum replicates them
+        # (every other stage contributes zeros).
+        return jax.lax.psum(jnp.where(idx == S - 1, outs, 0.0), axis)
+
+    spec = _stage_specs(stage_params, axis)
+    return jax.shard_map(worker, mesh=mesh, in_specs=(spec, x_spec),
+                         out_specs=x_spec, check_vma=False)(stage_params, x)
+
+
+def _stage_specs(stage_params, axis: str):
+    return jax.tree_util.tree_map(
+        lambda p: P(axis, *(None,) * (p.ndim - 1)), stage_params)
+
+
+def stack_stage_params(init_fn: Callable, rng, n_stages: int):
+    """Initialize per-stage params and stack them on a leading [S] dim
+    (shard with ``P('stage')`` before use)."""
+    keys = jax.random.split(rng, n_stages)
+    trees = [init_fn(k) for k in keys]
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)

@@ -42,14 +42,16 @@ def _batch_shardings(mesh: Mesh):
     return NamedSharding(mesh, P("data"))
 
 
-def _moe_aux_losses(intermediates) -> list:
-    """All 'moe_aux_loss' scalars sown anywhere in the model
-    (models/moe.py); flax sow stores tuples of appended values."""
-    out = []
+def _moe_router_stats(intermediates) -> list:
+    """All (probs, onehot) router tuples sown anywhere in the model
+    (models/moe.py 'moe_router'); flax sow wraps each in an append-tuple,
+    so pairs arrive as consecutive leaves under the same path."""
+    by_path = {}
     for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
-        if any(getattr(k, "key", None) == "moe_aux_loss" for k in path):
-            out.append(leaf)
-    return out
+        if any(getattr(k, "key", None) == "moe_router" for k in path):
+            key = tuple(str(k) for k in path[:-1])
+            by_path.setdefault(key, []).append(leaf)
+    return [tuple(v) for v in by_path.values() if len(v) == 2]
 
 
 def _replicated(mesh: Mesh):
@@ -107,10 +109,11 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                                        label_smoothing=smoothing,
                                        impl="fused" if optim_cfg.fused_loss
                                        else "reference", mesh=mesh)
-            moe_losses = _moe_aux_losses(mutated.get("intermediates", {}))
-            if moe_losses and model_cfg.moe_aux_weight:
-                loss = loss + model_cfg.moe_aux_weight * (
-                    sum(moe_losses) / len(moe_losses))
+            routers = _moe_router_stats(mutated.get("intermediates", {}))
+            if routers and model_cfg.moe_aux_weight:
+                from tpuic.models.moe import switch_aux_loss
+                aux = sum(switch_aux_loss(p, o, mask) for p, o in routers)
+                loss = loss + model_cfg.moe_aux_weight * aux / len(routers)
             logits = out[0] if isinstance(out, tuple) else out
             return loss, (mutated.get("batch_stats", state.batch_stats), logits)
 
