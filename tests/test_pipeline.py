@@ -110,6 +110,36 @@ def test_pipeline_composes_with_data_parallel(devices8):
         pipeline_apply(fn, stacked, x, mesh, x_spec=P("stage"))
 
 
+def test_pipeline_of_real_encoder_blocks(stage_mesh):
+    """4 real ViT EncoderBlocks pipelined over 4 stages == the same blocks
+    applied sequentially — transformer PP, not a toy stage."""
+    from flax import linen as nn
+    from tpuic.models.vit import EncoderBlock
+
+    D, N, mb, M = 16, 8, 2, 6
+    block = EncoderBlock(num_heads=4, dtype=jnp.float32)
+
+    def init_one(k):
+        return nn.meta.unbox(
+            block.init(k, jnp.zeros((mb, N, D)), True)["params"])
+
+    stacked = stack_stage_params(init_one, jax.random.key(5), 4)
+    stacked = jax.device_put(stacked, NamedSharding(stage_mesh, P("stage")))
+    x = jax.random.normal(jax.random.key(6), (M, mb, N, D)) * 0.5
+
+    def stage_fn(p, t):
+        return block.apply({"params": p}, t, True)
+
+    got = pipeline_apply(stage_fn, stacked, x, stage_mesh)
+    host = jax.device_get(stacked)
+    want = x
+    for s in range(4):
+        p = jax.tree_util.tree_map(lambda l: l[s], host)
+        want = jax.vmap(lambda t: block.apply({"params": p}, t, True))(want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
 def test_pipeline_microbatch_count_independence(setup, stage_mesh):
     """More microbatches = same math (GPipe's schedule is a pure
     reordering)."""

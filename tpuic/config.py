@@ -94,7 +94,8 @@ class ModelConfig:
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
     # MoE load-balancing loss weight (Switch Transformer's alpha; only
-    # active for *-moe models, which sow 'moe_aux_loss' intermediates).
+    # active for *-moe models, which sow 'moe_router' stats that the train
+    # step turns into a padding-masked switch_aux_loss).
     moe_aux_weight: float = 0.01
     # Attention implementation for attention-bearing backbones (ViT):
     # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,

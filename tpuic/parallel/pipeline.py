@@ -54,6 +54,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jnp.ndarray,
     S = mesh.shape[axis]
     M = x.shape[0]
 
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stage_params leaves must be stacked [{S}, ...] to match "
+                f"mesh axis '{axis}'; got leading dim {leaf.shape[0]} — a "
+                f"divisible mismatch would silently drop stages")
+
     def worker(params, xs):
         # Local [1, ...] slice of every stacked leaf -> this stage's params.
         local = jax.tree_util.tree_map(lambda p: p[0], params)

@@ -51,7 +51,13 @@ def _moe_router_stats(intermediates) -> list:
         if any(getattr(k, "key", None) == "moe_router" for k in path):
             key = tuple(str(k) for k in path[:-1])
             by_path.setdefault(key, []).append(leaf)
-    return [tuple(v) for v in by_path.values() if len(v) == 2]
+    for key, v in by_path.items():
+        # Fail fast on sow-structure drift: silently dropping groups here
+        # would silently drop the load-balancing loss from training.
+        if len(v) != 2:
+            raise ValueError(f"'moe_router' sow at {key} has {len(v)} "
+                             "leaves; expected (probs, onehot)")
+    return [tuple(v) for v in by_path.values()]
 
 
 def _replicated(mesh: Mesh):
