@@ -163,9 +163,8 @@ def _run_child(platform: str, timeout: float):
         # Drop this image's remote-TPU backend triggers (see sitecustomize):
         # with them set, backend selection is forced back to 'axon' and can
         # hang init even when CPU was requested.
-        for v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
-                  "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
-            env.pop(v, None)
+        from tpuic.runtime.axon_guard import drop_axon_vars
+        drop_axon_vars(env)
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     try:
         proc = subprocess.run(

@@ -34,33 +34,14 @@ import time
 # TPU backend whose init HANGS when the tunnel is down (round-1/2 failure
 # mode), so TPU reachability is probed in a killable child process first;
 # unreachable (or TPUIC_DATA_BENCH_CPU=1) falls back to CPU.
-import subprocess  # noqa: E402
-import sys  # noqa: E402
-
-
-def _force_cpu() -> None:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
-              "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
-        os.environ.pop(v, None)
-
+from tpuic.runtime.axon_guard import ensure_reachable_or_cpu, force_cpu  # noqa: E402
 
 if os.environ.get("TPUIC_DATA_BENCH_CPU"):
-    _force_cpu()
+    force_cpu()
 else:
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=float(os.environ.get("TPUIC_DATA_BENCH_PROBE_S", "90")),
-            capture_output=True)
-        if probe.returncode != 0:
-            _force_cpu()
-    except subprocess.TimeoutExpired:
-        _force_cpu()
+    ensure_reachable_or_cpu(timeout=float(
+        os.environ.get("TPUIC_DATA_BENCH_PROBE_S", "90")))
 import jax  # noqa: E402
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
 
 
 def _measure(loader, epochs=2, start=1) -> float:

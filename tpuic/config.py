@@ -125,6 +125,9 @@ class OptimConfig:
     lars_trust_coefficient: float = 0.001
     warmup_epochs: int = 0
     grad_clip_norm: float = 0.0
+    # Accumulate gradients over K steps before applying one optimizer
+    # update (effective batch = K * global batch). 1 = off.
+    grad_accum_steps: int = 1
     label_smoothing: float = 0.0
     # Use the fused Pallas cross-entropy kernel
     # (tpuic/kernels/cross_entropy.py) in the train step.

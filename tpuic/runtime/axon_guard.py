@@ -1,0 +1,76 @@
+"""Guard against the dev image's tunneled TPU backend hanging at init.
+
+This image's sitecustomize force-registers a remote 'axon' TPU backend
+whenever PALLAS_AXON_POOL_IPS is set; when the tunnel is down, backend
+initialization HANGS rather than erroring (rounds 1-3 failure mode, and a
+killed client wedges the chip for hours). Every entry point that must not
+hang shares these helpers:
+
+- ``drop_axon_vars(env)``: strip the trigger vars from a child-process env
+  so a CPU child stays a plain CPU interpreter.
+- ``force_cpu()``: switch THIS process to CPU (env + jax.config — the env
+  var alone loses to the sitecustomize's explicit platform registration).
+- ``tpu_reachable(timeout)``: probe backend init in a killable child.
+
+Real TPU hosts don't set the trigger vars; everything here is a no-op cost
+for them.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+AXON_ENV_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                 "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY")
+
+
+def is_tunneled() -> bool:
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def drop_axon_vars(env: dict) -> dict:
+    for v in AXON_ENV_VARS:
+        env.pop(v, None)
+    return env
+
+
+def force_cpu() -> None:
+    """Switch this process to the CPU backend (safe only before the first
+    device use)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    drop_axon_vars(os.environ)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def tpu_reachable(timeout: float = 90.0) -> bool:
+    """True when backend init completes in a killable child process."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_reachable_or_cpu(timeout: float | None = None,
+                            verbose: bool = True) -> bool:
+    """Probe the tunneled backend; fall back to CPU when unreachable.
+
+    Returns True when the TPU path is usable. No-op (True) off the dev
+    image."""
+    if not is_tunneled():
+        return True
+    t = timeout if timeout is not None else float(
+        os.environ.get("TPUIC_TPU_PROBE_S", "90"))
+    if tpu_reachable(t):
+        return True
+    if verbose:
+        print("[tpuic] TPU tunnel unreachable — falling back to CPU "
+              "(set TPUIC_TPU_PROBE_S to adjust the probe timeout)",
+              flush=True)
+    force_cpu()
+    return False

@@ -27,7 +27,13 @@ def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int) -> 
 
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
                    total_epochs: int = 100) -> optax.GradientTransformation:
-    lr = make_schedule(cfg, steps_per_epoch, total_epochs)
+    # Under gradient accumulation the inner transform's schedule counter
+    # advances once per REAL update (1 in K micro-steps), so its notion of
+    # an epoch must shrink by K — otherwise milestones/warmup stretch K-x
+    # in data time. The Trainer's logging schedule stays micro-step-based
+    # (state.step counts micro-steps), which lands on the same data epoch.
+    k = max(1, cfg.grad_accum_steps)
+    lr = make_schedule(cfg, max(1, steps_per_epoch // k), total_epochs)
     name = cfg.optimizer.lower()
     if name == "adam":
         tx = optax.adam(lr)
@@ -45,4 +51,11 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
         raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
     if cfg.grad_clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if cfg.grad_accum_steps > 1:
+        # Gradient accumulation: K micro-steps average their grads before
+        # one real update — the K-x-larger effective batch when it doesn't
+        # fit in HBM (the reference can only shrink its per-GPU batch,
+        # train.py:30). optax.MultiSteps keeps the accumulator inside
+        # opt_state, so it shards/checkpoints with everything else.
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
     return tx

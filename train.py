@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gamma", type=float, default=0.5)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--warmup-epochs", type=int, default=0)
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help="accumulate gradients over K steps before one "
+                        "optimizer update (effective batch = K * global)")
     p.add_argument("--class-weights", type=float, nargs="*",
                    default=[3, 3, 10, 1, 4, 4, 5],
                    help="CE class weights (reference train.py:157)")
@@ -109,7 +112,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           milestones=tuple(args.milestones), gamma=args.gamma,
                           class_weights=weights,
                           weight_decay=args.weight_decay,
-                          warmup_epochs=args.warmup_epochs),
+                          warmup_epochs=args.warmup_epochs,
+                          grad_accum_steps=args.grad_accum_steps),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
                       init_from=args.init_from,
@@ -122,6 +126,11 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # Dev-image guard: probe the tunneled TPU backend (whose init HANGS,
+    # not errors, when the tunnel is down) and fall back to CPU with a
+    # message instead of hanging the training command.
+    from tpuic.runtime.axon_guard import ensure_reachable_or_cpu
+    ensure_reachable_or_cpu()
     from tpuic.metrics.logging import host0_print
     from tpuic.runtime.distributed import initialize
     from tpuic.train.loop import Trainer
