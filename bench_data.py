@@ -36,11 +36,15 @@ import time
 # unreachable (or TPUIC_DATA_BENCH_CPU=1) falls back to CPU.
 from tpuic.runtime.axon_guard import ensure_reachable_or_cpu, force_cpu  # noqa: E402
 
-if os.environ.get("TPUIC_DATA_BENCH_CPU"):
-    force_cpu()
+if os.environ.get("TPUIC_DATA_BENCH_CPU") \
+        or os.environ.get("JAX_PLATFORMS") == "cpu":
+    force_cpu()  # also pins jax.config — env alone loses to sitecustomize
 else:
-    ensure_reachable_or_cpu(timeout=float(
-        os.environ.get("TPUIC_DATA_BENCH_PROBE_S", "90")))
+    # always_probe: a benchmark must emit a number on ANY backend failure
+    # (a held chip raises rather than hangs), tunneled or not.
+    ensure_reachable_or_cpu(
+        timeout=float(os.environ.get("TPUIC_DATA_BENCH_PROBE_S", "120")),
+        always_probe=True)
 import jax  # noqa: E402
 
 

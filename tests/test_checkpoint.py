@@ -145,6 +145,34 @@ def test_fsdp_restore_keeps_shardings_no_host_gather(tmp_path, devices8):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_moe_boxed_params_roundtrip(tmp_path):
+    """ViT/MoE params carry flax partitioning metadata boxes
+    (LogicallyPartitioned); save + sharded restore must round-trip them."""
+    from tpuic.models import create_model
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+
+    ocfg = OCFG
+    model = create_model("vit-tiny-moe", 3, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (2, 16, 16, 3))
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_best(state, epoch=1, best_score=10.0)
+    fresh = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(7), (2, 16, 16, 3))
+    restored, ep, best = mgr.restore_into(fresh)
+    assert (ep, best) == (2, 10.0)
+    unbox = lambda l: getattr(l, "value", l)
+    boxed = lambda x: hasattr(x, "value")
+    a = jax.tree_util.tree_leaves(
+        jax.tree.map(unbox, state.params, is_leaf=boxed))
+    b = jax.tree_util.tree_leaves(
+        jax.tree.map(unbox, restored.params, is_leaf=boxed))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 def test_lenient_restore_across_architectures(tmp_path):
     # Save a 3-class head, restore into a 4-class head: backbone transfers,
     # head output layer stays fresh (shape mismatch skipped).

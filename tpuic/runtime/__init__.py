@@ -1,2 +1,25 @@
-from tpuic.runtime.distributed import initialize, runtime_info  # noqa: F401
-from tpuic.runtime.mesh import make_mesh, data_sharding, replicated_sharding  # noqa: F401
+"""Runtime: multi-host init, mesh construction, tunneled-backend guard.
+
+Lazy re-exports (PEP 562): ``tpuic.runtime.axon_guard`` must stay importable
+without pulling in jax (see tpuic/__init__.py).
+"""
+
+_LAZY = {
+    "initialize": ("tpuic.runtime.distributed", "initialize"),
+    "runtime_info": ("tpuic.runtime.distributed", "runtime_info"),
+    "make_mesh": ("tpuic.runtime.mesh", "make_mesh"),
+    "data_sharding": ("tpuic.runtime.mesh", "data_sharding"),
+    "replicated_sharding": ("tpuic.runtime.mesh", "replicated_sharding"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'tpuic.runtime' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

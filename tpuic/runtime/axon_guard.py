@@ -45,8 +45,15 @@ def force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def tpu_reachable(timeout: float = 90.0) -> bool:
-    """True when backend init completes in a killable child process."""
+def tpu_reachable(timeout: float = 120.0) -> bool:
+    """True when backend init completes in a killable child process.
+
+    Risk note: killing the probe child on timeout terminates a client
+    mid-init. A client killed mid-WORK wedges the remote chip for hours
+    (measured round 3); an init-phase client has not yet been granted a
+    claim, so the probe is the least-bad place to take that risk — but
+    keep the timeout generous (backend init on a healthy tunnel takes
+    seconds, the bench budget allows 420)."""
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -57,15 +64,18 @@ def tpu_reachable(timeout: float = 90.0) -> bool:
 
 
 def ensure_reachable_or_cpu(timeout: float | None = None,
-                            verbose: bool = True) -> bool:
-    """Probe the tunneled backend; fall back to CPU when unreachable.
+                            verbose: bool = True,
+                            always_probe: bool = False) -> bool:
+    """Probe the backend; fall back to CPU when unreachable.
 
-    Returns True when the TPU path is usable. No-op (True) off the dev
-    image."""
-    if not is_tunneled():
+    Returns True when the accelerator path is usable. Off the dev image
+    the probe is skipped unless ``always_probe`` (benchmarks that promise
+    a result on ANY failure — e.g. a chip held by another process, which
+    raises rather than hangs — probe everywhere)."""
+    if not is_tunneled() and not always_probe:
         return True
     t = timeout if timeout is not None else float(
-        os.environ.get("TPUIC_TPU_PROBE_S", "90"))
+        os.environ.get("TPUIC_TPU_PROBE_S", "120"))
     if tpu_reachable(t):
         return True
     if verbose:

@@ -28,12 +28,14 @@ def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int) -> 
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
                    total_epochs: int = 100) -> optax.GradientTransformation:
     # Under gradient accumulation the inner transform's schedule counter
-    # advances once per REAL update (1 in K micro-steps), so its notion of
-    # an epoch must shrink by K — otherwise milestones/warmup stretch K-x
-    # in data time. The Trainer's logging schedule stays micro-step-based
-    # (state.step counts micro-steps), which lands on the same data epoch.
+    # advances once per REAL update (1 in K micro-steps), so map it back to
+    # micro-step time: lr(t_real) = micro_schedule(t_real * K). Exact for
+    # any K/steps_per_epoch combination (dividing steps_per_epoch by K
+    # would floor-drift milestones on small datasets), and identical to
+    # the Trainer's micro-step logging schedule in data time.
     k = max(1, cfg.grad_accum_steps)
-    lr = make_schedule(cfg, max(1, steps_per_epoch // k), total_epochs)
+    micro = make_schedule(cfg, steps_per_epoch, total_epochs)
+    lr = micro if k == 1 else (lambda t: micro(t * k))
     name = cfg.optimizer.lower()
     if name == "adam":
         tx = optax.adam(lr)
