@@ -68,3 +68,24 @@ def test_init_from_torch_checkpoint(imagefolder, tmp_path, devices8):
     got = np.asarray(trainer.state.params["backbone"]["conv1"]["kernel"])
     want = np.transpose(tm.conv1.weight.detach().numpy(), (2, 3, 1, 0))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_collect_misclassified_ids(imagefolder, tmp_path, devices8):
+    """RunConfig.collect_misclassified: after a val epoch every misclassified
+    sample is named by image id, the count reconciles with val accuracy, and
+    the ids are real dataset ids — the reference's per-sample all_gather
+    capability (train.py:92, ddp_utils.py:16-56) without the pickle."""
+    cfg = _config(imagefolder, tmp_path, epochs=1)
+    cfg = dataclasses.replace(
+        cfg, run=dataclasses.replace(cfg.run, collect_misclassified=True,
+                                     resume=False))
+    trainer = Trainer(cfg)
+    score = trainer.val_epoch(0)
+    n_val = len(trainer.val_ds)
+    expected_wrong = round(n_val * (1.0 - score / 100.0))
+    assert len(trainer.last_misclassified) == expected_wrong
+    valid = {trainer.val_ds.image_id(i) for i in range(n_val)}
+    assert set(trainer.last_misclassified) <= valid
+    # Every id unique: padding duplicates must not leak in.
+    assert len(set(trainer.last_misclassified)) == \
+        len(trainer.last_misclassified)
