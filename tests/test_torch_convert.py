@@ -8,6 +8,8 @@ initialized state_dict with ``convert_resnet``, and asserts the Flax model
 produces the same logits in eval mode.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -132,6 +134,81 @@ def test_detect_resnet_depth(torch_model):
     assert detect_resnet_depth(torch_model.state_dict()) == "resnet18"
     from tpuic.checkpoint.torch_ref import build_resnet as br
     assert detect_resnet_depth(br("resnet50", 7).state_dict()) == "resnet50"
+
+
+def test_export_resnet_roundtrips_into_torch_replica():
+    """INVERSE converter: a tpuic resnet18 state exported to the reference
+    torch layout loads strict=True into the replica and produces the same
+    logits — a tpuic-trained model can flow back to torch consumers."""
+    from tpuic.checkpoint.torch_convert import export_resnet
+
+    model = create_model("resnet18", 7, dtype="float32")
+    x = np.random.default_rng(3).normal(size=(2, 64, 64, 3)).astype(
+        np.float32)
+    v = model.init(jax.random.key(1), jnp.zeros((1, 64, 64, 3)), train=False)
+    want = np.asarray(model.apply(v, jnp.asarray(x), train=False))
+
+    sd = export_resnet(dict(v["params"]), dict(v["batch_stats"]),
+                       prefix="")
+    replica = build_resnet("resnet18", num_classes=7).eval()
+    replica.load_state_dict(  # strict: every key must land
+        {k: torch.as_tensor(np.asarray(val)) for k, val in sd.items()},
+        strict=True)
+    with torch.no_grad():
+        got = replica(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+    # ...and the exported file converts BACK bitwise through convert_resnet.
+    tree = convert_resnet(sd)
+    for path_val in (("backbone", "conv1", "kernel"),
+                     ("head", "out", "bias")):
+        a = tree["params"]
+        b = v["params"]
+        for k in path_val:
+            a, b = a[k], b[k]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_cli_from_orbax_checkpoint(tmp_path, capsys):
+    """--export-torch: Orbax checkpoint dir -> reference-layout torch file
+    that --verify then validates against the replica."""
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.checkpoint.torch_convert import main
+    from tpuic.config import OptimConfig
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+
+    ocfg = OptimConfig(optimizer="adam", learning_rate=1e-3,
+                       class_weights=(), milestones=())
+    model = create_model("resnet18", 7, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (2, 32, 32, 3))
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_best(state, epoch=4, best_score=80.0)
+    mgr.wait()
+    out = str(tmp_path / "best_model")
+    # --export-torch --verify composes: export, then validate the file.
+    assert main([os.path.join(mgr.root, "best"), "--export-torch", out,
+                 "--verify", "--image-size", "48"]) == 0
+    printed = capsys.readouterr().out
+    assert '"exported"' in printed and '"verify": "ok"' in printed
+
+
+def test_export_rejects_non_resnet_tree():
+    from tpuic.checkpoint.torch_convert import export_resnet
+
+    with pytest.raises(ValueError, match="no 'layer"):
+        export_resnet({"backbone": {"stem_conv": {}}, "head": {}}, {})
+
+
+def test_export_single_linear_head_maps_to_plain_fc():
+    from tpuic.checkpoint.torch_convert import export_resnet
+
+    model = create_model("resnet18", 5, head_widths=(), dtype="float32")
+    v = model.init(jax.random.key(2), jnp.zeros((1, 32, 32, 3)), train=False)
+    sd = export_resnet(dict(v["params"]), dict(v["batch_stats"]), prefix="")
+    assert "fc.weight" in sd and "fc.0.weight" not in sd
+    assert sd["fc.weight"].shape == (5, 512)
 
 
 def test_cli_verify_reference_checkpoint(torch_model, tmp_path, capsys):

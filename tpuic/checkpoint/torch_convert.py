@@ -34,6 +34,7 @@ the reference's lenient partial load (train.py:143-148).
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Any, Dict, Mapping, Tuple
 
@@ -466,6 +467,82 @@ def convert_reference_checkpoint(path: str,
 
 
 # ---------------------------------------------------------------------------
+# Inverse direction: tpuic Flax trees -> torch state_dict (resnet family)
+# ---------------------------------------------------------------------------
+
+def _unbox(leaf):
+    return np.asarray(getattr(leaf, "value", leaf))
+
+
+def _conv_inv(w) -> np.ndarray:
+    return np.transpose(_unbox(w), (3, 2, 0, 1))  # HWIO -> OIHW
+
+
+_RESNET_LEAF_INV = {v: k for k, v in _RESNET_LEAF.items()}
+
+
+def export_resnet(params: Mapping[str, Any], batch_stats: Mapping[str, Any],
+                  prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
+    """tpuic resnet {'params','batch_stats'} -> reference-layout state_dict.
+
+    The exact inverse of ``convert_resnet`` (HWIO->OIHW convs, transposed
+    linears, scale/bias->weight/bias, mean/var->running_mean/running_var,
+    with num_batches_tracked=0 re-synthesized and DDP's ``module.encoder.``
+    prefix re-applied by default — reference train.py:179 saves it). Lets a
+    tpuic-trained model flow back into the reference's resume path
+    (train.py:132-150) or any torchvision consumer.
+    """
+    bb = params.get("backbone", {})
+    bs = batch_stats.get("backbone", {})
+    head = params.get("head", {})
+    if not any(n.startswith("layer") for n in bb):
+        raise ValueError(
+            "export_resnet: params['backbone'] has no 'layer*' modules — "
+            f"not a resnet checkpoint (got {sorted(bb)[:6]}...); only the "
+            "resnet family exports to the torch layout")
+    sd: Dict[str, np.ndarray] = {}
+
+    def put_bn(torch_name: str, p: Mapping, s: Mapping) -> None:
+        sd[f"{torch_name}.weight"] = _unbox(p["scale"])
+        sd[f"{torch_name}.bias"] = _unbox(p["bias"])
+        sd[f"{torch_name}.running_mean"] = _unbox(s["mean"])
+        sd[f"{torch_name}.running_var"] = _unbox(s["var"])
+        sd[f"{torch_name}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    for name, sub in bb.items():
+        if name == "conv1":
+            sd["conv1.weight"] = _conv_inv(sub["kernel"])
+        elif name == "bn1":
+            put_bn("bn1", sub, bs["bn1"])
+        elif name.startswith("layer"):
+            stage, block = name[len("layer"):].split("_")
+            for mod, leaves in sub.items():
+                torch_mod = _RESNET_LEAF_INV.get(mod)
+                if torch_mod is None:
+                    continue
+                tname = f"layer{stage}.{block}.{torch_mod}"
+                if "kernel" in leaves:
+                    sd[f"{tname}.weight"] = _conv_inv(leaves["kernel"])
+                else:
+                    put_bn(tname, leaves, bs[name][mod])
+    # Head: fc{i} hidden layers at Sequential indices 0,2,4,... (ReLUs take
+    # the odd slots) and 'out' after them — matches the reference layout for
+    # the default (128,64,32) head and stays consistent for any
+    # head_widths; a widths=() head is a single Linear, exported as the
+    # plain torchvision 'fc'.
+    fcs = sorted((m for m in head if re.fullmatch(r"fc\d+", m)),
+                 key=lambda m: int(m[2:]))
+    for i, mod in enumerate(fcs):
+        sd[f"fc.{2 * i}.weight"] = np.transpose(_unbox(head[mod]["kernel"]))
+        sd[f"fc.{2 * i}.bias"] = _unbox(head[mod]["bias"])
+    if "out" in head:
+        out_name = f"fc.{2 * len(fcs)}" if fcs else "fc"
+        sd[f"{out_name}.weight"] = np.transpose(_unbox(head["out"]["kernel"]))
+        sd[f"{out_name}.bias"] = _unbox(head["out"]["bias"])
+    return {prefix + k: v for k, v in sd.items()}
+
+
+# ---------------------------------------------------------------------------
 # CLI:  python -m tpuic.checkpoint.torch_convert <ckpt> [--verify]
 # ---------------------------------------------------------------------------
 
@@ -495,17 +572,54 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpuic.checkpoint.torch_convert", description=__doc__)
     ap.add_argument("checkpoint", help="reference best_model/latest_model "
-                    "file or a bare torch state_dict file")
+                    "file or a bare torch state_dict file; with "
+                    "--export-torch, a tpuic Orbax checkpoint dir "
+                    "(ckpt_dir/<model>/{best|latest})")
     ap.add_argument("--arch", default="auto",
                     help="backbone family (default: sniffed from keys)")
     ap.add_argument("--verify", action="store_true",
                     help="run torch replica vs converted Flax model and "
                     "print max logits delta")
+    ap.add_argument("--export-torch", metavar="OUT", default="",
+                    help="INVERSE direction: read a tpuic Orbax checkpoint "
+                    "and write a reference-layout torch file (resnet "
+                    "family) to OUT")
     ap.add_argument("--image-size", type=int, default=128)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--tol", type=float, default=1e-3,
                     help="--verify failure threshold on max |delta|")
     args = ap.parse_args(argv)
+
+    if args.export_torch:
+        import orbax.checkpoint as ocp
+        import torch
+
+        restored = ocp.PyTreeCheckpointer().restore(
+            os.path.abspath(args.checkpoint))
+        sd = export_resnet(restored["params"], restored["batch_stats"])
+        meta = restored.get("meta", {})
+
+        def torchable(v):
+            a = np.asarray(v)
+            # ml_dtypes (bfloat16) numpy arrays are opaque to torch.
+            if a.dtype.kind == "f" and a.dtype not in (np.float16,
+                                                       np.float32,
+                                                       np.float64):
+                a = a.astype(np.float32)
+            return torch.as_tensor(a)
+
+        torch.save({"epoch": int(meta.get("epoch", 0)),
+                    "best_score": float(meta.get("best_score", 0.0)),
+                    "state_dict": {k: torchable(v) for k, v in sd.items()}},
+                   args.export_torch)
+        print(json.dumps({"exported": args.export_torch,
+                          "keys": len(sd),
+                          "epoch": int(meta.get("epoch", 0))}))
+        if not args.verify:
+            return 0
+        # --verify composes: fall through and validate the exported file
+        # like any reference checkpoint.
+        args.checkpoint = args.export_torch
 
     payload = load_reference_checkpoint(args.checkpoint)
     sd = payload["state_dict"]
@@ -526,7 +640,6 @@ def main(argv=None) -> int:
     if not args.verify:
         return 0
 
-    import numpy as np
     import torch
 
     import jax
