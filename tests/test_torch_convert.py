@@ -224,3 +224,25 @@ def test_cli_verify_reference_checkpoint(torch_model, tmp_path, capsys):
     assert main([path, "--verify", "--image-size", "48"]) == 0
     out = capsys.readouterr().out
     assert '"verify": "ok"' in out and '"arch": "resnet18"' in out
+
+
+def test_export_nonstandard_head_roundtrips():
+    """head_widths=(128, 64): export emits fc.0/2/4 and the dynamic
+    fc-mapping converts it back with every head leaf landing (no silent
+    fresh-init head)."""
+    from tpuic.checkpoint.torch_convert import export_resnet
+
+    model = create_model("resnet18", 5, head_widths=(128, 64),
+                         dtype="float32")
+    v = model.init(jax.random.key(4), jnp.zeros((1, 32, 32, 3)), train=False)
+    sd = export_resnet(dict(v["params"]), dict(v["batch_stats"]), prefix="")
+    assert {"fc.0.weight", "fc.2.weight", "fc.4.weight"} <= set(sd)
+    tree = convert_resnet(sd)
+    head = tree["params"]["head"]
+    assert set(head) == {"fc0", "fc1", "out"}
+    np.testing.assert_array_equal(np.asarray(head["out"]["bias"]),
+                                  np.asarray(v["params"]["head"]["out"]
+                                             ["bias"]))
+    # _infer_head handles it too (the --verify entry path).
+    from tpuic.checkpoint.torch_convert import _infer_head
+    assert _infer_head(sd) == (5, True)

@@ -111,6 +111,34 @@ def test_efficientnet_forward_parity():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
 
 
+def test_export_inception_roundtrips_into_torch_replica():
+    """INVERSE converter for the reference's DEFAULT backbone: a tpuic
+    inceptionv3 state exported to torchvision layout loads strict=True into
+    the replica with matching logits."""
+    from tpuic.checkpoint.torch_convert import export_state_dict
+
+    model = create_model("inceptionv3", 7, dtype="float32")
+    x = np.random.default_rng(6).normal(size=(2, 128, 128, 3)).astype(
+        np.float32)
+    # train=True materializes the aux head (nn.compact only creates params
+    # on the executed path), so the export covers AuxLogits too.
+    v = model.init(jax.random.key(3), jnp.zeros((1, 128, 128, 3)),
+                   train=True)
+    v = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    want = np.asarray(model.apply(v, jnp.asarray(x), train=False))
+
+    sd = export_state_dict(dict(v["params"]), dict(v["batch_stats"]),
+                           prefix="")
+    replica = build_inception(num_classes=7).eval()
+    replica.load_state_dict(
+        {k: torch.as_tensor(np.asarray(val)) for k, val in sd.items()},
+        strict=True)
+    with torch.no_grad():
+        got = replica(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
 def test_detect_arch():
     assert detect_arch({"Mixed_5b.branch1x1.conv.weight": 0}) == "inceptionv3"
     assert detect_arch({"_blocks.0._bn1.weight": 0}) == "efficientnet"

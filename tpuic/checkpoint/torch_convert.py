@@ -75,8 +75,18 @@ _RESNET_LEAF = {
     "downsample.0": "downsample_conv", "downsample.1": "downsample_bn",
 }
 
-# the reference head's Sequential Linear indices (nn/classifier.py:26-34)
-_HEAD_FC = {"0": "fc0", "2": "fc1", "4": "fc2", "6": "out"}
+def _head_fc_mapping(keys) -> Dict[str, str]:
+    """Sequential Linear index -> tpuic head module, derived from the
+    checkpoint's own ``fc.N.*`` keys: hidden layers in order become
+    fc0..fcK-1, the LAST Linear is 'out'. For the reference head
+    (nn/classifier.py:26-34) this yields {0: fc0, 2: fc1, 4: fc2, 6: out};
+    nonstandard head_widths (any even-index spacing) map consistently, so
+    export -> convert round-trips for every head shape."""
+    idxs = sorted({int(m.group(1)) for k in keys
+                   if (m := re.match(r"(?:.*\.)?fc\.(\d+)\.(weight|bias)$",
+                                     k))})
+    return {str(i): (f"fc{n}" if n < len(idxs) - 1 else "out")
+            for n, i in enumerate(idxs)}
 
 _BLOCK_RE = re.compile(r"^layer(\d+)\.(\d+)\.(.+)$")
 
@@ -91,6 +101,7 @@ def convert_resnet(state_dict: Mapping[str, Any],
     use ``lenient_restore`` to merge into a live model state.
     """
     sd = strip_prefixes(state_dict)
+    fc_map = _head_fc_mapping(sd)
     params: Dict = {}
     stats: Dict = {}
 
@@ -135,7 +146,7 @@ def convert_resnet(state_dict: Mapping[str, Any],
             continue
 
         # -- head ------------------------------------------------------------
-        _put_head_fc(params, name, leaf, v, head_scope)
+        _put_head_fc(params, name, leaf, v, head_scope, fc_map)
 
     return {"params": params, "batch_stats": stats}
 
@@ -186,13 +197,15 @@ _INCEPTION_BRANCH = {
 
 
 def _put_head_fc(params: Dict, name: str, leaf: str, v: np.ndarray,
-                 head_scope: str) -> bool:
-    """Map the reference MLP head (``fc.0/2/4/6``) or a plain single ``fc``
-    Linear onto the tpuic head scope. Returns True when consumed."""
+                 head_scope: str, fc_map: Mapping[str, str]) -> bool:
+    """Map an MLP head (``fc.N`` Sequential Linears, reference layout) or a
+    plain single ``fc`` Linear onto the tpuic head scope. ``fc_map`` comes
+    from ``_head_fc_mapping`` over the checkpoint's keys. Returns True when
+    consumed."""
     if not (name == "fc" or name.startswith("fc.")):
         return False
     rest = name[2:].lstrip(".")
-    target = _HEAD_FC.get(rest) if rest else "out"
+    target = fc_map.get(rest) if rest else "out"
     if target is None:
         return False
     if leaf == "weight":
@@ -213,6 +226,7 @@ def convert_inception(state_dict: Mapping[str, Any],
     keys are skipped; merge with ``lenient_restore``.
     """
     sd = strip_prefixes(state_dict)
+    fc_map = _head_fc_mapping(sd)
     params: Dict = {}
     stats: Dict = {}
 
@@ -260,7 +274,8 @@ def convert_inception(state_dict: Mapping[str, Any],
                     _set(params, (backbone_scope, "aux", "fc", "bias"), v)
             continue
 
-        _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope)
+        _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope,
+                     fc_map)
 
     return {"params": params, "batch_stats": stats}
 
@@ -383,7 +398,8 @@ def convert_efficientnet(state_dict: Mapping[str, Any], variant: str = "b3",
             elif leaf == "bias":
                 _set(params, (head_scope, "out", "bias"), v)
         else:
-            _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope)
+            _put_head_fc(params, ".".join(parts[:-1]), leaf, v, head_scope,
+                     fc_map)
 
     return {"params": params, "batch_stats": stats}
 
@@ -467,7 +483,7 @@ def convert_reference_checkpoint(path: str,
 
 
 # ---------------------------------------------------------------------------
-# Inverse direction: tpuic Flax trees -> torch state_dict (resnet family)
+# Inverse direction: tpuic Flax trees -> torch state_dict (resnet + inception families)
 # ---------------------------------------------------------------------------
 
 def _unbox(leaf):
@@ -479,6 +495,22 @@ def _conv_inv(w) -> np.ndarray:
 
 
 _RESNET_LEAF_INV = {v: k for k, v in _RESNET_LEAF.items()}
+
+
+def _export_head(head: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """tpuic head/{fc0..,out} -> fc.{0,2,4,...} Sequential keys (ReLUs take
+    the odd slots), or the plain torchvision 'fc' for a single Linear."""
+    sd: Dict[str, np.ndarray] = {}
+    fcs = sorted((m for m in head if re.fullmatch(r"fc\d+", m)),
+                 key=lambda m: int(m[2:]))
+    for i, mod in enumerate(fcs):
+        sd[f"fc.{2 * i}.weight"] = np.transpose(_unbox(head[mod]["kernel"]))
+        sd[f"fc.{2 * i}.bias"] = _unbox(head[mod]["bias"])
+    if "out" in head:
+        out_name = f"fc.{2 * len(fcs)}" if fcs else "fc"
+        sd[f"{out_name}.weight"] = np.transpose(_unbox(head["out"]["kernel"]))
+        sd[f"{out_name}.bias"] = _unbox(head["out"]["bias"])
+    return sd
 
 
 def export_resnet(params: Mapping[str, Any], batch_stats: Mapping[str, Any],
@@ -525,21 +557,71 @@ def export_resnet(params: Mapping[str, Any], batch_stats: Mapping[str, Any],
                     sd[f"{tname}.weight"] = _conv_inv(leaves["kernel"])
                 else:
                     put_bn(tname, leaves, bs[name][mod])
-    # Head: fc{i} hidden layers at Sequential indices 0,2,4,... (ReLUs take
-    # the odd slots) and 'out' after them — matches the reference layout for
-    # the default (128,64,32) head and stays consistent for any
-    # head_widths; a widths=() head is a single Linear, exported as the
-    # plain torchvision 'fc'.
-    fcs = sorted((m for m in head if re.fullmatch(r"fc\d+", m)),
-                 key=lambda m: int(m[2:]))
-    for i, mod in enumerate(fcs):
-        sd[f"fc.{2 * i}.weight"] = np.transpose(_unbox(head[mod]["kernel"]))
-        sd[f"fc.{2 * i}.bias"] = _unbox(head[mod]["bias"])
-    if "out" in head:
-        out_name = f"fc.{2 * len(fcs)}" if fcs else "fc"
-        sd[f"{out_name}.weight"] = np.transpose(_unbox(head["out"]["kernel"]))
-        sd[f"{out_name}.bias"] = _unbox(head["out"]["bias"])
+    sd.update(_export_head(head))
     return {prefix + k: v for k, v in sd.items()}
+
+
+def export_inception(params: Mapping[str, Any],
+                     batch_stats: Mapping[str, Any],
+                     prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
+    """tpuic InceptionV3 trees -> torchvision-layout state_dict (incl. the
+    aux head) — the inverse of ``convert_inception``, covering the
+    reference's DEFAULT backbone (train.py:122)."""
+    bb = params.get("backbone", {})
+    bs = batch_stats.get("backbone", {})
+    if "mixed5b" not in bb:
+        raise ValueError(
+            "export_inception: params['backbone'] has no 'mixed5b' — not an "
+            f"inception checkpoint (got {sorted(bb)[:6]}...)")
+    stem_inv = {v: k for k, v in _INCEPTION_STEM.items()}
+    block_inv = {k.lower().replace("_", ""): k for k in _INCEPTION_FAMILY}
+    branch_inv = {fam: {v: k for k, v in m.items()}
+                  for fam, m in _INCEPTION_BRANCH.items()}
+    sd: Dict[str, np.ndarray] = {}
+
+    def put_convbn(tname: str, p: Mapping, s: Mapping) -> None:
+        sd[f"{tname}.conv.weight"] = _conv_inv(p["conv"]["kernel"])
+        sd[f"{tname}.bn.weight"] = _unbox(p["bn"]["scale"])
+        sd[f"{tname}.bn.bias"] = _unbox(p["bn"]["bias"])
+        sd[f"{tname}.bn.running_mean"] = _unbox(s["bn"]["mean"])
+        sd[f"{tname}.bn.running_var"] = _unbox(s["bn"]["var"])
+        sd[f"{tname}.bn.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    for name, sub in bb.items():
+        if name in stem_inv:
+            put_convbn(stem_inv[name], sub, bs[name])
+        elif name in block_inv:
+            tblock = block_inv[name]
+            fam = _INCEPTION_FAMILY[tblock]
+            for br, leaves in sub.items():
+                tbranch = branch_inv[fam].get(br)
+                if tbranch is not None:
+                    put_convbn(f"{tblock}.{tbranch}", leaves, bs[name][br])
+        elif name == "aux":
+            for conv in ("conv0", "conv1"):
+                if conv in sub:
+                    put_convbn(f"AuxLogits.{conv}", sub[conv],
+                               bs["aux"][conv])
+            if "fc" in sub:
+                sd["AuxLogits.fc.weight"] = np.transpose(
+                    _unbox(sub["fc"]["kernel"]))
+                sd["AuxLogits.fc.bias"] = _unbox(sub["fc"]["bias"])
+    sd.update(_export_head(params.get("head", {})))
+    return {prefix + k: v for k, v in sd.items()}
+
+
+def export_state_dict(params: Mapping[str, Any],
+                      batch_stats: Mapping[str, Any],
+                      prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
+    """Auto-dispatch tpuic->torch export by sniffing the backbone tree."""
+    bb = params.get("backbone", {})
+    if any(n.startswith("layer") for n in bb):
+        return export_resnet(params, batch_stats, prefix)
+    if "mixed5b" in bb:
+        return export_inception(params, batch_stats, prefix)
+    raise ValueError(
+        "export_state_dict: unsupported backbone for torch export "
+        f"(got {sorted(bb)[:6]}...); supported: resnet*, inceptionv3")
 
 
 # ---------------------------------------------------------------------------
@@ -549,8 +631,10 @@ def export_resnet(params: Mapping[str, Any], batch_stats: Mapping[str, Any],
 def _infer_head(state_dict: Mapping[str, Any]) -> Tuple[int, bool]:
     """(num_classes, mlp_head) from the checkpoint's own head keys."""
     flat = strip_prefixes(state_dict)
-    if "fc.6.bias" in flat:       # reference MLP head (fc.0/2/4/6)
-        return int(flat["fc.6.bias"].shape[0]), True
+    fc_map = _head_fc_mapping(flat)
+    out_idx = next((i for i, t in fc_map.items() if t == "out"), None)
+    if out_idx is not None:       # Sequential MLP head (reference layout)
+        return int(flat[f"fc.{out_idx}.bias"].shape[0]), len(fc_map) > 1
     for k in ("fc.bias", "_fc.bias"):   # plain torchvision / effnet _fc
         if k in flat:
             return int(flat[k].shape[0]), False
@@ -582,8 +666,8 @@ def main(argv=None) -> int:
                     "print max logits delta")
     ap.add_argument("--export-torch", metavar="OUT", default="",
                     help="INVERSE direction: read a tpuic Orbax checkpoint "
-                    "and write a reference-layout torch file (resnet "
-                    "family) to OUT")
+                    "and write a reference-layout torch file (resnet + "
+                    "inceptionv3 families) to OUT; composes with --verify")
     ap.add_argument("--image-size", type=int, default=128)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--tol", type=float, default=1e-3,
@@ -596,15 +680,17 @@ def main(argv=None) -> int:
 
         restored = ocp.PyTreeCheckpointer().restore(
             os.path.abspath(args.checkpoint))
-        sd = export_resnet(restored["params"], restored["batch_stats"])
+        sd = export_state_dict(restored["params"], restored["batch_stats"])
         meta = restored.get("meta", {})
 
         def torchable(v):
             a = np.asarray(v)
-            # ml_dtypes (bfloat16) numpy arrays are opaque to torch.
-            if a.dtype.kind == "f" and a.dtype not in (np.float16,
-                                                       np.float32,
-                                                       np.float64):
+            # ml_dtypes (bfloat16) numpy arrays are opaque to torch; their
+            # dtype.kind is 'V' (void), not 'f'.
+            if a.dtype.kind == "V" or (a.dtype.kind == "f"
+                                       and a.dtype not in (np.float16,
+                                                           np.float32,
+                                                           np.float64)):
                 a = a.astype(np.float32)
             return torch.as_tensor(a)
 
