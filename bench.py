@@ -220,13 +220,46 @@ def main() -> None:
             if errors:  # a preferred platform failed first
                 result.setdefault("detail", {})["fallback"] = platform
                 result["error"] = "; ".join(errors)
+                _attach_last_tpu(result)
             print(json.dumps(result), flush=True)
             return
         errors.append(err)
-    print(json.dumps({
+    out = {
         "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
         "error": "; ".join(errors) or "no platforms attempted",
-    }), flush=True)
+    }
+    _attach_last_tpu(out)
+    print(json.dumps(out), flush=True)
+
+
+def _attach_last_tpu(result: dict) -> None:
+    """When the TPU path failed (dev tunnel down — it hung for 8+ hours in
+    round 3), surface the last committed real-chip measurement
+    (perf/sweep.json, scripts/perf_sweep.py) with provenance so the
+    fallback artifact still carries the chip's demonstrated capability."""
+    try:
+        path = os.path.join(_REPO, "perf", "sweep.json")
+        with open(path) as f:
+            sweep = json.load(f)
+        rows = [r for r in sweep.get("results", [])
+                if "images_per_sec_per_chip" in r]
+        if not rows:
+            return
+        best = max(rows, key=lambda r: r["images_per_sec_per_chip"])
+        result.setdefault("detail", {})["last_tpu_measurement"] = {
+            "images_per_sec_per_chip": best["images_per_sec_per_chip"],
+            "mfu": best.get("mfu"),
+            "per_chip_batch": best.get("per_chip_batch"),
+            "device": sweep.get("device"),
+            "source": "perf/sweep.json",
+            # File mtime, NOT measurement time: git checkouts reset mtimes,
+            # so this only bounds how recently the artifact was touched.
+            "file_mtime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ",
+                time.gmtime(os.path.getmtime(path))),
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
 
 
 if __name__ == "__main__":

@@ -168,3 +168,15 @@ def test_detect_efficientnet_variant():
     assert "block6_0" in tree["params"]["backbone"]  # last stage, b0 naming
     with pytest.raises(ValueError, match="no _blocks"):
         detect_efficientnet_variant({"layer1.0.conv1.weight": 0})
+
+
+def test_efficientnet_mlp_head_keys_convert():
+    """Regression: the efficientnet converter's MLP-head branch (the
+    reference-style fc.N Sequential) must not NameError on fc_map."""
+    sd = {"fc.0.weight": np.zeros((128, 1280), np.float32),
+          "fc.0.bias": np.zeros((128,), np.float32),
+          "fc.2.weight": np.zeros((7, 128), np.float32),
+          "fc.2.bias": np.zeros((7,), np.float32)}
+    tree = convert_efficientnet(sd, variant="b0")
+    assert set(tree["params"]["head"]) == {"fc0", "out"}
+    assert tree["params"]["head"]["out"]["kernel"].shape == (128, 7)

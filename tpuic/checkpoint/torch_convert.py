@@ -345,6 +345,7 @@ def convert_efficientnet(state_dict: Mapping[str, Any], variant: str = "b3",
     MLP (``fc.0/2/4/6``) maps to the full head.
     """
     sd = strip_prefixes(state_dict)
+    fc_map = _head_fc_mapping(sd)
     coords = _effnet_block_coords(variant)
     params: Dict = {}
     stats: Dict = {}
