@@ -25,6 +25,11 @@ sys.path.insert(0, _REPO)
 
 
 def main():
+    from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
+    if is_tunneled() and not tpu_reachable(150):
+        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
+        sys.exit(2)
+
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
@@ -43,8 +48,14 @@ def main():
 
     root = tempfile.mkdtemp(prefix="tpuic_fitproof_")
     t0 = time.perf_counter()
+    # Val is 1/8 of train: the proof measures the TRAIN loop's throughput;
+    # a full-size val fold only adds pack time and resident-cache upload.
     make_synthetic_imagefolder(root, classes=("a", "b", "c", "d"),
-                               per_class=n_per_class, size=224)
+                               per_class=n_per_class, size=224,
+                               folds=("train",))
+    make_synthetic_imagefolder(root, classes=("a", "b", "c", "d"),
+                               per_class=max(64, n_per_class // 8), size=224,
+                               folds=("val",))
     make_time = time.perf_counter() - t0
     ckpt = os.path.join(root, "ckpt")
     log_dir = os.path.join(_REPO, "perf", "fit_proof_logs")

@@ -29,6 +29,7 @@ The TPU-native replacement for the reference's
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -39,6 +40,32 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuic.data.folder import ImageFolderDataset
+
+# Resident-cache uploads go to the device in bounded slices. One giant
+# device_put of the whole uint8 dataset is a single multi-hundred-MB
+# transfer; on a slow/flaky host->device link (the tunneled dev platform)
+# that is the observed wedge trigger, while chunking costs only one extra
+# on-device copy (the concatenate) during a one-time setup step.
+_UPLOAD_CHUNK_BYTES = int(os.environ.get("TPUIC_UPLOAD_CHUNK_MB", "64")) << 20
+
+
+def _upload_resident_chunked(arr) -> jax.Array:
+    """Single-device upload of a [N, ...] host array in ~chunk-sized slices.
+
+    ``arr`` may be a np.memmap (the packed cache) — slices are materialized
+    one chunk at a time, so host RSS stays bounded too."""
+    import jax.numpy as jnp
+
+    row_bytes = max(1, int(arr.nbytes // max(1, len(arr))))
+    rows = max(1, _UPLOAD_CHUNK_BYTES // row_bytes)
+    if len(arr) <= rows:
+        return jax.device_put(np.ascontiguousarray(arr))
+    parts = []
+    for lo in range(0, len(arr), rows):
+        parts.append(jax.device_put(np.ascontiguousarray(arr[lo:lo + rows])))
+    out = jnp.concatenate(parts, axis=0)
+    out.block_until_ready()  # parts stay alive until the copy completes
+    return out
 
 
 class Batch(dict):
@@ -139,11 +166,15 @@ class Loader:
                       if device_cache_bytes is None
                       else int(device_cache_bytes))
             if budget and data_bytes <= budget:
-                arr = np.asarray(dataset.array())
+                arr = dataset.array()
                 if mesh is None:
-                    self._data_dev = jax.device_put(arr)
+                    self._data_dev = _upload_resident_chunked(arr)
                     repl = None
                 else:
+                    # Multi-device: lazy per-device puts (replication may
+                    # target non-addressable devices on multi-host, which
+                    # device_put of a host array cannot express).
+                    arr = np.asarray(arr)
                     repl = NamedSharding(mesh, P())
                     self._data_dev = jax.make_array_from_callback(
                         arr.shape, repl, lambda idx: arr[idx])
