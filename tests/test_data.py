@@ -121,3 +121,20 @@ def test_simulated_multihost_padding_mask(imagefolder):
             masks.append(np.asarray(batch["mask"]))
     total_valid = sum(m.sum() for m in masks)
     assert total_valid == len(ds)
+
+
+def test_dataset_smaller_than_global_batch(imagefolder):
+    """A fold smaller than the global batch still yields one full padded
+    batch (regression: order[:pad] with pad > n silently produced zero
+    batches)."""
+    from tpuic.config import DataConfig
+    ds = ImageFolderDataset(imagefolder, "val", 16, DataConfig(native=False))
+    n = len(ds)
+    gb = 4 * n
+    loader = Loader(ds, global_batch=gb, shuffle=False, num_workers=1)
+    assert len(loader) == 1
+    batches = list(loader.epoch(0))
+    assert len(batches) == 1
+    mask = np.asarray(batches[0]["mask"])
+    assert mask.sum() == n  # every real sample exactly once
+    assert mask.shape[0] == gb

@@ -483,6 +483,42 @@ def convert_reference_checkpoint(path: str,
     return tree
 
 
+def init_state_from_torch(state, path: str, model_name: str, log=print):
+    """Convert a torch checkpoint and leniently merge it into ``state``.
+
+    The shared --init-from path (Trainer and tpuic.predict): family
+    auto-detected, unmapped/mismatched leaves keep their fresh init —
+    the reference's partial load semantics (train.py:143-148). For
+    ``*-s2d`` models a pretrained 7x7 stem kernel is re-indexed to the
+    space-to-depth layout (models/resnet.py:s2d_stem_kernel) before the
+    merge, since lenient_restore would otherwise shape-skip it silently.
+    """
+    import jax
+
+    from tpuic.checkpoint.manager import lenient_restore
+
+    tree = convert_reference_checkpoint(path)
+    if model_name.endswith("-s2d"):
+        from tpuic.models.resnet import s2d_stem_kernel
+        conv1 = tree.get("params", {}).get("backbone", {}).get("conv1")
+        kshape = getattr((conv1 or {}).get("kernel"), "shape", None)
+        if kshape is not None and kshape[0] == 7:
+            conv1["kernel"] = np.asarray(
+                s2d_stem_kernel(np.asarray(conv1["kernel"])))
+        else:
+            log(f"[init] {path}: no 7x7 stem kernel to convert for "
+                f"{model_name} (found {kshape}); stem keeps fresh init")
+    params, n, total = lenient_restore(
+        jax.tree.map(np.asarray, jax.device_get(state.params)),
+        tree["params"])
+    stats, n_s, total_s = lenient_restore(
+        jax.tree.map(np.asarray, jax.device_get(state.batch_stats)),
+        tree["batch_stats"])
+    log(f"[init] {path}: loaded {n}/{total} param and "
+        f"{n_s}/{total_s} batch-stat leaves")
+    return state.replace(params=params, batch_stats=stats)
+
+
 # ---------------------------------------------------------------------------
 # Inverse direction: tpuic Flax trees -> torch state_dict (resnet + inception families)
 # ---------------------------------------------------------------------------

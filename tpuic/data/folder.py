@@ -69,6 +69,14 @@ class ImageFolderDataset:
                 fpath = os.path.join(cdir, fname)
                 if _is_image(fpath):
                     samples.append((fpath, self.class_to_idx[cls]))
+        # Flat (unlabeled) fold: images directly under the fold dir, no
+        # class subdirectories. Label is -1; inference-only (tpuic.predict)
+        # — the Trainer's loss would reject it.
+        self.labeled = bool(samples)
+        if not samples:
+            samples = [(os.path.join(root, f), -1)
+                       for f in sorted(os.listdir(root))
+                       if _is_image(os.path.join(root, f))]
         if not samples:
             raise ValueError(f"no images under {root}")
         self.samples = samples

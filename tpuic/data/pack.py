@@ -158,6 +158,10 @@ class PackedDataset:
         self.class_to_idx: Dict[str, int] = dict(meta["class_to_idx"])
         self.classes: List[str] = sorted(self.class_to_idx,
                                          key=self.class_to_idx.get)
+        # Flat/unlabeled source folds store label -1 per sample
+        # (folder.py flat path); mirror ImageFolderDataset.labeled.
+        self.labeled = bool(len(self._labels) == 0
+                            or int(self._labels.min()) >= 0)
         n, s = int(meta["n"]), self.resize_size
         self._mm = np.memmap(bin_path, np.uint8, "r", shape=(n, s, s, 3))
 

@@ -98,7 +98,9 @@ def _epoch_indices(n: int, epoch: int, seed: int, shuffle: bool,
         order = np.arange(n)
     pad = (-n) % global_batch
     if pad:
-        order = np.concatenate([order, order[:pad]])
+        # np.resize tiles cyclically — correct even when pad > n (a dataset
+        # smaller than the global batch still yields one full padded batch).
+        order = np.resize(order, n + pad)
     return order, n  # (padded order, number of valid entries)
 
 

@@ -1,0 +1,196 @@
+"""Batch inference CLI: classify an ImageFolder fold with a trained model.
+
+The reference repo trains and validates but has no standalone prediction
+path — its users run `val_epoch` (train.py:78-97) and read the printed
+accuracy. This module is that capability as a first-class tool: load a
+tpuic checkpoint (or a reference/torchvision torch checkpoint directly),
+run the fold through the jitted eval forward, and write per-image
+predictions to CSV.
+
+    python -m tpuic.predict --datadir /data/x --model resnet50 \
+        --ckpt-dir dtmodel/cp                  # best track by default
+    python -m tpuic.predict --datadir /data/x --model inceptionv3 \
+        --init-from best_model --fold val --out preds.csv --top-k 3
+
+Output CSV columns: image_id, label (ground-truth class name, '' when the
+fold carries none), pred (top-1 class name), prob (softmax of top-1), then
+pred_2/prob_2..pred_k/prob_k when --top-k > 1. When labels exist, overall
+accuracy is printed — the same exact global number val_epoch reports.
+
+Single-process by design: prediction over an ImageFolder is host-IO bound
+and the packed loader feeds one chip comfortably (docs/performance.md);
+multi-host users run one instance per fold/shard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+
+def build_predict_fn(model):
+    """Jitted ``(variables, images) -> (probs, top_idx)`` forward."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(variables, images):
+        logits = model.apply(variables, images, train=False)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        return probs, order
+
+    return jax.jit(fwd)
+
+
+def run_predict(cfg, *, fold: str, track: str, top_k: int,
+                out_path: Optional[str], limit: int = 0) -> dict:
+    """Programmatic entry; returns summary stats (rows written, accuracy)."""
+    import jax
+
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pipeline import Loader
+    from tpuic.models import create_model_from_config
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+
+    d = cfg.data
+    # class_to_idx=None derives the canonical mapping from the train fold
+    # when present (the order the checkpoint was trained with), else from
+    # the requested fold (folder.py:53-59). A fold of images with NO class
+    # subdirectories is served unlabeled (label -1, folder.py flat path).
+    ds = ImageFolderDataset(d.data_dir, fold, d.resize_size, d)
+    has_labels = ds.labeled
+    if d.pack:
+        from tpuic.data.pack import pack_dataset
+        cache = d.cache_dir or os.path.join(d.data_dir, ".tpuic_pack")
+        ds = pack_dataset(ds, cache, verbose=True)
+
+    num_classes = cfg.model.num_classes or ds.num_classes
+    if num_classes <= 0:
+        raise ValueError("--num-classes is required for an unlabeled fold "
+                         "with no train/ tree to infer the classes from")
+    mcfg = cfg.model
+    if num_classes != mcfg.num_classes:
+        import dataclasses
+        mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
+    model = create_model_from_config(mcfg)
+    state = create_train_state(
+        model, make_optimizer(cfg.optim), jax.random.key(0),
+        (1, d.resize_size, d.resize_size, 3))
+
+    if cfg.run.init_from:
+        from tpuic.checkpoint.torch_convert import init_state_from_torch
+        state = init_state_from_torch(state, cfg.run.init_from, mcfg.name,
+                                      log=print)
+    else:
+        mgr = CheckpointManager(cfg.run.ckpt_dir, mcfg.name)
+        if not os.path.isdir(os.path.join(mgr.root, track)):
+            # restore_into would silently return the fresh init — a typo'd
+            # --ckpt-dir must not produce a confident CSV of noise.
+            raise FileNotFoundError(
+                f"no '{track}' checkpoint under {mgr.root}")
+        state, next_epoch, best = mgr.restore_into(state, track=track)
+        print(f"[predict] restored {mcfg.name}/{track} (saved at epoch "
+              f"{max(0, next_epoch - 1)}, best {best:.2f})")
+
+    # One up-front transfer: the lenient-restore path leaves host numpy
+    # leaves, which a jitted call would re-upload every batch.
+    variables = jax.device_put(
+        {"params": state.params, "batch_stats": state.batch_stats})
+    predict = build_predict_fn(model)
+    # Class names come from the fold tree; an unlabeled flat fold has none,
+    # so predictions fall back to the raw class index as a string.
+    idx_to_class = {i: c for c, i in ds.class_to_idx.items()}
+    for i in range(num_classes):
+        idx_to_class.setdefault(i, str(i))
+    k = max(1, min(top_k, num_classes))
+
+    loader = Loader(ds, cfg.data.resolved_val_batch_size(), shuffle=False,
+                    num_workers=d.num_workers, prefetch=d.prefetch)
+    rows, correct, count = [], 0, 0
+    for batch in loader.epoch(0):
+        probs, order = predict(variables, batch["image"])
+        probs, order = np.asarray(probs), np.asarray(order)
+        labels = np.asarray(batch["label"])
+        mask = np.asarray(batch["mask"])
+        for i, image_id in enumerate(batch.image_ids):
+            if mask[i] == 0:  # epoch padding
+                continue
+            row = {"image_id": image_id,
+                   "label": idx_to_class.get(int(labels[i]), "")
+                            if has_labels else "",
+                   "pred": idx_to_class.get(int(order[i, 0]), ""),
+                   "prob": f"{probs[i, order[i, 0]]:.6f}"}
+            for j in range(1, k):
+                row[f"pred_{j + 1}"] = idx_to_class.get(int(order[i, j]), "")
+                row[f"prob_{j + 1}"] = f"{probs[i, order[i, j]]:.6f}"
+            rows.append(row)
+            if has_labels:
+                correct += int(order[i, 0] == labels[i])
+                count += 1
+            if limit and len(rows) >= limit:
+                break
+        if limit and len(rows) >= limit:
+            break
+
+    if out_path:
+        with open(out_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()) if rows
+                               else ["image_id", "label", "pred", "prob"])
+            w.writeheader()
+            w.writerows(rows)
+        print(f"[predict] wrote {len(rows)} rows -> {out_path}")
+    summary = {"rows": len(rows), "fold": fold}
+    if has_labels and count:
+        summary["accuracy"] = 100.0 * correct / count
+        print(f"[predict] accuracy over {count} labeled samples: "
+              f"{summary['accuracy']:.2f}%")
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Classify an ImageFolder fold with a trained checkpoint")
+    p.add_argument("--datadir", required=True)
+    p.add_argument("--fold", default="val")
+    p.add_argument("--model", default="inceptionv3")
+    p.add_argument("--num-classes", type=int, default=0,
+                   help="0 = infer from the folder tree")
+    p.add_argument("--resize", type=int, default=299)
+    p.add_argument("--batchsize", type=int, default=64)
+    p.add_argument("--ckpt-dir", default="dtmodel/cp")
+    p.add_argument("--track", default="best", choices=("best", "latest"))
+    p.add_argument("--init-from", default="",
+                   help="torch checkpoint instead of a tpuic one")
+    p.add_argument("--out", default="", help="CSV output path")
+    p.add_argument("--top-k", type=int, default=1)
+    p.add_argument("--limit", type=int, default=0,
+                   help="stop after N rows (smoke runs)")
+    p.add_argument("--no-pack", action="store_true")
+    args = p.parse_args(argv)
+
+    from tpuic.config import Config, DataConfig, ModelConfig, RunConfig
+    cfg = Config(
+        data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
+                        batch_size=args.batchsize,
+                        val_batch_size=args.batchsize,
+                        pack=not args.no_pack),
+        model=ModelConfig(name=args.model, num_classes=args.num_classes),
+        run=RunConfig(ckpt_dir=args.ckpt_dir, init_from=args.init_from),
+    )
+    summary = run_predict(cfg, fold=args.fold, track=args.track,
+                          top_k=args.top_k, out_path=args.out or None,
+                          limit=args.limit)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

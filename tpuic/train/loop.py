@@ -131,42 +131,16 @@ class Trainer:
         """Pretrained-weight initialization from a torch checkpoint.
 
         The reference starts every backbone pretrained (nn/classifier.py:9-21);
-        this converts the torch state_dict (family auto-detected) and merges
-        params + batch_stats leniently — unmapped leaves keep the fresh init,
-        exactly like the reference's partial load (train.py:143-148).
-        """
-        from tpuic.checkpoint.manager import lenient_restore
-        from tpuic.checkpoint.torch_convert import convert_reference_checkpoint
+        the conversion + lenient merge (and the *-s2d stem re-indexing) is
+        shared with tpuic.predict in checkpoint.torch_convert."""
+        from tpuic.checkpoint.torch_convert import init_state_from_torch
 
-        tree = convert_reference_checkpoint(path)
-        if self.cfg.model.name.endswith("-s2d"):
-            # The space-to-depth variant is the same network with a
-            # re-indexed stem kernel (models/resnet.py:s2d_stem_kernel) —
-            # pretrained 7x7 stems convert exactly.
-            from tpuic.models.resnet import s2d_stem_kernel
-            conv1 = tree.get("params", {}).get("backbone", {}).get("conv1")
-            kshape = getattr((conv1 or {}).get("kernel"), "shape", None)
-            if kshape is not None and kshape[0] == 7:
-                conv1["kernel"] = np.asarray(
-                    s2d_stem_kernel(np.asarray(conv1["kernel"])))
-            else:
-                # Silent shape-skip in lenient_restore would leave the stem
-                # at random init with no signal — say so.
-                host0_print(f"[init] {path}: no 7x7 stem kernel to convert "
-                            f"for {self.cfg.model.name} (found {kshape}); "
-                            "stem keeps fresh init")
-        params, n, total = lenient_restore(
-            jax.tree.map(np.asarray, jax.device_get(self.state.params)),
-            tree["params"])
-        stats, n_s, total_s = lenient_restore(
-            jax.tree.map(np.asarray, jax.device_get(self.state.batch_stats)),
-            tree["batch_stats"])
-        self.state = self.state.replace(params=params, batch_stats=stats)
+        self.state = init_state_from_torch(self.state, path,
+                                           self.cfg.model.name,
+                                           log=host0_print)
         if self.state_sharding is not None:
             from tpuic.parallel.sharding import shard_state
             self.state = shard_state(self.state, self.state_sharding)
-        host0_print(f"[init] {path}: loaded {n}/{total} param and "
-                    f"{n_s}/{total_s} batch-stat leaves")
 
     # -- epochs -------------------------------------------------------------
     def train_epoch(self, epoch: int) -> float:
