@@ -153,3 +153,37 @@ def test_predict_unlabeled_no_train_tree(trained, tmp_path):
     with open(str(tmp_path / "lone.csv")) as f:
         row = list(csv.DictReader(f))[0]
     assert row["pred"] in {"0", "1", "2"} and row["label"] == ""
+
+
+def test_predict_wrong_model_for_checkpoint_raises(trained):
+    """An architecture mismatch must error, not emit fresh-init noise."""
+    root, ckpt, _, _ = trained
+    import shutil
+    # Masquerade the resnet18 checkpoint as a vit-tiny one.
+    src = os.path.join(ckpt, "resnet18-cifar")
+    dst = os.path.join(ckpt, "vit-tiny")
+    if not os.path.isdir(dst):
+        shutil.copytree(src, dst)
+    pcfg = Config(
+        data=DataConfig(data_dir=root, resize_size=24, batch_size=4,
+                        val_batch_size=4),
+        model=ModelConfig(name="vit-tiny", num_classes=0, dtype="float32"),
+        run=RunConfig(ckpt_dir=ckpt),
+    )
+    with pytest.raises(ValueError, match="wrong --model"):
+        run_predict(pcfg, fold="val", track="best", top_k=1, out_path=None)
+    # The masquerade dir would poison other tests' ckpt fixture — remove.
+    shutil.rmtree(dst)
+
+
+def test_flat_train_fold_still_rejected(tmp_path):
+    """A mis-structured train fold (loose images, no class dirs) stays a
+    hard error for training paths — the unlabeled fallback is opt-in."""
+    from PIL import Image
+    from tpuic.data.folder import ImageFolderDataset
+    root = str(tmp_path / "bad")
+    os.makedirs(os.path.join(root, "train"))
+    Image.fromarray(np.zeros((24, 24, 3), np.uint8)).save(
+        os.path.join(root, "train", "oops.png"))
+    with pytest.raises(ValueError, match="no images"):
+        ImageFolderDataset(root, "train", 24, DataConfig(native=False))

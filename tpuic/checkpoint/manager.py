@@ -139,8 +139,12 @@ class CheckpointManager:
         """Reference train.py:183-188 — every ``save_period`` epochs
         (``epoch % period == 0``, so epoch 0 saves, like the reference)."""
         if epoch % self.save_period == 0:
-            self._save("latest", state, epoch, best_score)
-            host0_print(f"[ckpt] latest -> {self.root}/latest (epoch {epoch})")
+            self.save_latest(state, epoch, best_score)
+
+    def save_latest(self, state, epoch: int, best_score: float) -> None:
+        """Unconditional ``latest`` save (preemption flush; period ignored)."""
+        self._save("latest", state, epoch, best_score)
+        host0_print(f"[ckpt] latest -> {self.root}/latest (epoch {epoch})")
 
     # -- restore ------------------------------------------------------------
     def _track_epoch(self, track: str) -> Optional[int]:
@@ -203,6 +207,12 @@ class CheckpointManager:
         (train.py:143-148).
         """
         self.wait()  # don't read a track an async save is still writing
+        # (n_loaded, n_total) of the last restore's param-leaf merge; None
+        # for the sharded fast path (exact structure = full load). Lets
+        # callers (tpuic.predict) distinguish "architecture mismatch, zero
+        # leaves matched" from a legitimate restore without changing the
+        # return contract.
+        self.last_restore_loaded = None
         if track is None:
             track = self.newest_track()
             if track is None:
@@ -260,4 +270,5 @@ class CheckpointManager:
                             "state reset")
         host0_print(f"[ckpt] restored {n_loaded}/{n_total} param leaves from "
                     f"{path} (epoch {epoch}, best {best:.4f})")
+        self.last_restore_loaded = (n_loaded, n_total)
         return state, epoch + 1 if n_loaded else 0, best

@@ -166,6 +166,11 @@ class RunConfig:
     # (SURVEY.md §5); jax.profiler makes it nearly free so it is first-class.
     profile_dir: str = ""
     profile_steps: int = 0
+    # Install a SIGTERM latch (runtime/preemption.py): on pod preemption /
+    # scheduler eviction the loop finishes its step, flushes a 'latest'
+    # checkpoint, and returns instead of dying mid-epoch. The reference
+    # loses everything since the last periodic save (SURVEY.md §5).
+    handle_preemption: bool = True
     seed: int = 0
 
 

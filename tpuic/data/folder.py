@@ -40,7 +40,8 @@ def _is_image(path: str) -> bool:
 class ImageFolderDataset:
     def __init__(self, data_dir: str, fold: str, resize_size: int,
                  cfg: Optional[DataConfig] = None,
-                 class_to_idx: Optional[Dict[str, int]] = None) -> None:
+                 class_to_idx: Optional[Dict[str, int]] = None,
+                 allow_unlabeled: bool = False) -> None:
         self.cfg = cfg or DataConfig()
         self.data_dir = data_dir
         self.fold = fold
@@ -70,10 +71,12 @@ class ImageFolderDataset:
                 if _is_image(fpath):
                     samples.append((fpath, self.class_to_idx[cls]))
         # Flat (unlabeled) fold: images directly under the fold dir, no
-        # class subdirectories. Label is -1; inference-only (tpuic.predict)
-        # — the Trainer's loss would reject it.
+        # class subdirectories. Label is -1. Opt-in (tpuic.predict passes
+        # allow_unlabeled=True): training on label -1 would silently
+        # produce a zero one-hot target and a degenerate loss, so for the
+        # Trainer a flat fold stays the hard error it always was.
         self.labeled = bool(samples)
-        if not samples:
+        if not samples and allow_unlabeled:
             samples = [(os.path.join(root, f), -1)
                        for f in sorted(os.listdir(root))
                        if _is_image(os.path.join(root, f))]

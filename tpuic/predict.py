@@ -65,7 +65,8 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
     # when present (the order the checkpoint was trained with), else from
     # the requested fold (folder.py:53-59). A fold of images with NO class
     # subdirectories is served unlabeled (label -1, folder.py flat path).
-    ds = ImageFolderDataset(d.data_dir, fold, d.resize_size, d)
+    ds = ImageFolderDataset(d.data_dir, fold, d.resize_size, d,
+                            allow_unlabeled=True)
     has_labels = ds.labeled
     if d.pack:
         from tpuic.data.pack import pack_dataset
@@ -97,6 +98,17 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
             raise FileNotFoundError(
                 f"no '{track}' checkpoint under {mgr.root}")
         state, next_epoch, best = mgr.restore_into(state, track=track)
+        loaded = mgr.last_restore_loaded  # None = exact sharded restore
+        if loaded is not None and loaded[0] < loaded[1]:
+            # Inference needs the FULL tree: a partial key-intersection
+            # merge (a training-time feature for architecture evolution)
+            # means fresh-init leaves in the forward — erroring beats a
+            # confident CSV of noise. Mismatches here are almost always a
+            # wrong --model/--num-classes for the checkpoint.
+            raise ValueError(
+                f"checkpoint {mgr.root}/{track} restored only "
+                f"{loaded[0]}/{loaded[1]} leaves into model '{mcfg.name}' — "
+                "wrong --model or --num-classes for this checkpoint?")
         print(f"[predict] restored {mcfg.name}/{track} (saved at epoch "
               f"{max(0, next_epoch - 1)}, best {best:.2f})")
 

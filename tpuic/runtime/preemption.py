@@ -1,0 +1,103 @@
+"""Graceful-preemption guard: SIGTERM -> checkpoint -> clean exit.
+
+TPU pods (and most cluster schedulers) deliver SIGTERM with a grace window
+before killing the worker. The reference has no preemption story at all —
+a killed rank loses everything since the last periodic save and wedges the
+other ranks' NCCL collectives (SURVEY.md §5 "Failure detection: Absent").
+Here the Trainer polls this guard between steps; on a pending signal it
+saves a ``latest`` checkpoint at the current epoch and returns instead of
+dying mid-write. Resume then continues from that epoch.
+
+The flag-poll design (rather than doing work inside the handler) is
+deliberate: Python signal handlers run between bytecodes on the main
+thread, and checkpoint saving from inside a handler could re-enter Orbax
+mid-save. The handler only records; the training loop acts.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+
+class PreemptionGuard:
+    """Installable SIGTERM (by default) latch.
+
+    Usage::
+
+        guard = PreemptionGuard().install()
+        ...
+        if guard.triggered:  # between steps / epochs
+            save_and_exit()
+
+    ``install`` chains any previously-installed handler (so outer runtimes
+    still observe the signal) and is a no-op off the main thread, where
+    CPython forbids signal.signal.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)) -> None:
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        self._event.set()
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def install(self) -> "PreemptionGuard":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal would raise; poll still works via set()
+        for s in self._signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except (ValueError, OSError):  # exotic embedding; stay inert
+                pass
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def trigger(self) -> None:
+        """Programmatic trip (tests; cooperative shutdown)."""
+        self._event.set()
+
+
+def agree(flag: bool) -> bool:
+    """Cross-host OR of the local latch.
+
+    On a multi-host pod the scheduler delivers SIGTERM per host, at
+    slightly different times (or to a subset). A host that acted on its
+    LOCAL flag alone would leave the step loop while the others enter the
+    next step's collectives — a mutual hang that burns the whole grace
+    window (the exact wedge this module exists to avoid). So the loop only
+    acts on the flag at common step boundaries, through this agreement:
+    every host calls agree() at the same point, the flags are OR-reduced
+    across processes, and all hosts see the same verdict. Single-process
+    runs pay nothing.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return bool(np.any(multihost_utils.process_allgather(
+        np.asarray([bool(flag)]))))

@@ -113,6 +113,13 @@ class Trainer:
         self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
+        # SIGTERM (pod preemption / scheduler eviction) -> finish the
+        # current step, flush a 'latest' checkpoint, return cleanly
+        # (runtime/preemption.py). The handler is installed for the span of
+        # fit() only; polling is a flag read per step, with a cross-host
+        # agreement at fixed boundaries on multi-host pods.
+        from tpuic.runtime.preemption import PreemptionGuard
+        self.preemption = PreemptionGuard()
         self.logger = MetricLogger(log_dir)
         self.start_epoch = 0
         self.best_score = 0.0
@@ -152,7 +159,24 @@ class Trainer:
         log_every = max(1, self.cfg.run.log_every_steps)
         global_batch = self.train_loader.global_batch
         t_log = time.perf_counter()
+        from tpuic.runtime.preemption import agree
+        multi = jax.process_count() > 1
+        # Multi-host: a locally-latched SIGTERM may only be acted on at a
+        # boundary every host reaches together (agree() is a collective);
+        # 16 steps of latency is well inside any grace window.
+        preempt_sync = 16
         for step, batch in enumerate(bar):
+            trig = self.preemption.triggered
+            if multi:
+                if step % preempt_sync == 0:
+                    trig = agree(trig)
+                    if trig:
+                        self.preemption.trigger()  # latch the agreement
+                else:
+                    trig = False  # never act unilaterally between boundaries
+            if trig:
+                bar.close()
+                break
             self.state, metrics = self.train_step(
                 self.state, {k: batch[k] for k in ("image", "label", "mask")})
             if (step + 1) % log_every == 0:
@@ -218,25 +242,44 @@ class Trainer:
 
     # -- driver -------------------------------------------------------------
     def fit(self, epochs: Optional[int] = None) -> float:
+        from tpuic.runtime.preemption import agree
         epochs = epochs if epochs is not None else self.cfg.run.epochs
         best = self.best_score
         profiled = False
-        for epoch in range(self.start_epoch, epochs):
-            if (self.cfg.run.profile_dir and not profiled
-                    and epoch == self.start_epoch):
-                jax.profiler.start_trace(self.cfg.run.profile_dir)
-                profiled = True
-            t0 = time.time()
-            self.train_epoch(epoch)
-            score = self.val_epoch(epoch)
-            host0_print(f"Epoch {epoch} took {time.time() - t0:.1f}s")
-            if profiled:
-                jax.profiler.stop_trace()
-                profiled = False
-            if score > best:
-                best = score
-                self.ckpt.save_best(self.state, epoch, best)
-            self.ckpt.maybe_save_latest(self.state, epoch, best)
+        if self.cfg.run.handle_preemption:
+            self.preemption.install()
+        try:
+            for epoch in range(self.start_epoch, epochs):
+                if (self.cfg.run.profile_dir and not profiled
+                        and epoch == self.start_epoch):
+                    jax.profiler.start_trace(self.cfg.run.profile_dir)
+                    profiled = True
+                t0 = time.time()
+                self.train_epoch(epoch)
+                # Epoch end is a common boundary: agree so a host whose
+                # local SIGTERM missed the last in-epoch sync point doesn't
+                # diverge from the others (val vs flush).
+                if agree(self.preemption.triggered):
+                    self.preemption.trigger()
+                    # Grace windows are short: skip val and flush 'latest'.
+                    # Saved as epoch-1 so resume (restore_into returns
+                    # saved+1) replays the interrupted epoch rather than
+                    # skipping its unseen tail.
+                    host0_print(f"[preempt] signal received during epoch "
+                                f"{epoch}; flushing latest and exiting")
+                    self.ckpt.save_latest(self.state, epoch - 1, best)
+                    break
+                score = self.val_epoch(epoch)
+                host0_print(f"Epoch {epoch} took {time.time() - t0:.1f}s")
+                if profiled:
+                    jax.profiler.stop_trace()
+                    profiled = False
+                if score > best:
+                    best = score
+                    self.ckpt.save_best(self.state, epoch, best)
+                self.ckpt.maybe_save_latest(self.state, epoch, best)
+        finally:
+            self.preemption.uninstall()
         self.ckpt.wait()  # commit any in-flight async save before returning
         self.best_score = best
         return best
