@@ -149,6 +149,28 @@ def _measure(platform: str) -> dict:
                          time.gmtime(os.path.getmtime(path)))}
     except (OSError, ValueError):
         pass
+    # Companion artifacts (same provenance rule as the profile breakdown):
+    # the input-pipeline and end-to-end-loop numbers that bound this step
+    # rate in real training.
+    companions = {}
+    # An optional enrichment artifact must never sink the measurement —
+    # tolerate any malformed content, not just missing/unparseable files.
+    try:
+        with open(os.path.join(_REPO, "perf", "bench_data.json")) as f:
+            ld = json.load(f)
+        if isinstance(ld, dict):
+            companions["loader_images_per_sec_per_host"] = ld.get("value")
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(_REPO, "perf", "fit_proof.json")) as f:
+            fp = json.load(f)
+        if isinstance(fp, dict):
+            companions["fit_loop_images_per_sec"] = fp.get(
+                "loop_images_per_sec_median_steady")
+            companions["fit_loop_vs_bench"] = fp.get("loop_vs_bench")
+    except Exception:
+        pass
     return {
         "metric": METRIC,
         "value": round(images_per_sec / n_chips, 2),
@@ -166,6 +188,7 @@ def _measure(platform: str) -> dict:
             "compile_s": round(compile_s, 1),
             "dtype": mcfg.dtype,
             "profile_breakdown": breakdown,
+            "companions": companions or None,
             "analysis": "PERF_ANALYSIS.md",
         },
     }

@@ -28,3 +28,24 @@ def test_accuracy_matches_argmax_eq():
     labels = jnp.array([1, 1, 1])
     acc = accuracy(logits, labels)
     np.testing.assert_array_equal(np.asarray(acc), [1.0, 0.0, 1.0])
+
+
+def test_topk_accuracy_membership():
+    from tpuic.metrics.meters import topk_accuracy
+    logits = jnp.asarray([
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # label 0: top-1
+        [5.0, 9.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # label 5: rank 6 -> miss
+        [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 9.0],   # label 2: rank 5 -> hit
+    ])
+    labels = jnp.asarray([0, 5, 2])
+    top1 = accuracy(logits, labels)
+    top5 = topk_accuracy(logits, labels, 5)
+    assert top1.tolist() == [1.0, 0.0, 0.0]
+    assert top5.tolist() == [1.0, 0.0, 1.0]
+    # k >= C degenerates to all-hit.
+    assert topk_accuracy(logits, labels, 99).tolist() == [1.0, 1.0, 1.0]
+    # top-5 dominates top-1 pointwise on random data.
+    rng = np.random.default_rng(0)
+    lg = jnp.asarray(rng.standard_normal((64, 10)).astype(np.float32))
+    lb = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+    assert bool(jnp.all(topk_accuracy(lg, lb, 5) >= accuracy(lg, lb)))

@@ -14,6 +14,7 @@ TPU-native re-design of the reference's ``utils.py``:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -43,3 +44,15 @@ def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     logits: [B, C] float; labels: [B] int. Returns [B] float32 of 0.0/1.0.
     """
     return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+
+
+def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  k: int = 5) -> jnp.ndarray:
+    """Per-sample 0/1 top-k membership (the ImageNet convention the
+    reference never reports; k is clamped to the class count).
+
+    logits: [B, C] float; labels: [B] int. Returns [B] float32 of 0.0/1.0.
+    """
+    k = min(k, logits.shape[-1])
+    _, idx = jax.lax.top_k(logits, k)  # [B, k]
+    return jnp.any(idx == labels[:, None], axis=-1).astype(jnp.float32)

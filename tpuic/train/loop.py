@@ -160,14 +160,16 @@ class Trainer:
         global_batch = self.train_loader.global_batch
         t_log = time.perf_counter()
         from tpuic.runtime.preemption import agree
+        preempt_on = self.cfg.run.handle_preemption
         multi = jax.process_count() > 1
         # Multi-host: a locally-latched SIGTERM may only be acted on at a
         # boundary every host reaches together (agree() is a collective);
-        # 16 steps of latency is well inside any grace window.
+        # 16 steps of latency is well inside any grace window. With
+        # handle_preemption off, no polling (and no allgather) happens.
         preempt_sync = 16
         for step, batch in enumerate(bar):
-            trig = self.preemption.triggered
-            if multi:
+            trig = preempt_on and self.preemption.triggered
+            if preempt_on and multi:
                 if step % preempt_sync == 0:
                     trig = agree(trig)
                     if trig:
@@ -208,7 +210,8 @@ class Trainer:
         """Reference val_epoch (train.py:78-97): exact global accuracy ×100,
         plus the exact global weighted val CE (num/den accumulated
         separately)."""
-        correct = count = loss_num = loss_den = 0.0
+        correct = correct5 = count = loss_num = loss_den = 0.0
+        have_top5 = False
         collect = self.cfg.run.collect_misclassified
         misclassified: list = []
         for batch in self.val_loader.epoch(epoch):
@@ -218,6 +221,9 @@ class Trainer:
             count += float(m["count"])
             loss_num += float(m["loss_num"])
             loss_den += float(m["loss_den"])
+            if "correct5" in m:
+                have_top5 = True
+                correct5 += float(m["correct5"])
             if collect:
                 # 'wrong' is the GLOBAL per-sample vector (replicated out of
                 # the sharded step = all-gather over ICI); batch.indices is
@@ -233,9 +239,13 @@ class Trainer:
             self.last_misclassified = misclassified
         score = 100.0 * correct / max(count, 1.0)
         val_loss = loss_num / max(loss_den, 1e-12)
-        host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}; "
-                    f"Val Loss {val_loss:.4f}")
         extra = {"n_misclassified": len(misclassified)} if collect else {}
+        top5_msg = ""
+        if have_top5:
+            extra["val_top5"] = 100.0 * correct5 / max(count, 1.0)
+            top5_msg = f"; Top-5 {extra['val_top5']:.4f}"
+        host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}{top5_msg}; "
+                    f"Val Loss {val_loss:.4f}")
         self.logger.write(int(jax.device_get(self.state.step)),
                           val_accuracy=score, val_loss=val_loss, **extra)
         return score
@@ -259,8 +269,12 @@ class Trainer:
                 # Epoch end is a common boundary: agree so a host whose
                 # local SIGTERM missed the last in-epoch sync point doesn't
                 # diverge from the others (val vs flush).
-                if agree(self.preemption.triggered):
+                if (self.cfg.run.handle_preemption
+                        and agree(self.preemption.triggered)):
                     self.preemption.trigger()
+                    if profiled:
+                        jax.profiler.stop_trace()
+                        profiled = False
                     # Grace windows are short: skip val and flush 'latest'.
                     # Saved as epoch-1 so resume (restore_into returns
                     # saved+1) replays the interrupted epoch rather than

@@ -32,7 +32,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuic.config import ModelConfig, OptimConfig
-from tpuic.metrics.meters import accuracy
+from tpuic.metrics.meters import accuracy, topk_accuracy
 from tpuic.train.loss import classification_loss
 from tpuic.train.state import TrainState
 
@@ -195,6 +195,9 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         loss_den = jnp.sum(w)
         out = {"correct": jnp.sum(acc * m), "count": jnp.sum(m),
                "loss_num": loss * loss_den, "loss_den": loss_den}
+        if logits.shape[-1] > 5:
+            # Top-5 (the ImageNet convention; meaningless below 6 classes).
+            out["correct5"] = jnp.sum(topk_accuracy(logits, labels, 5) * m)
         if per_sample:
             out["wrong"] = (1.0 - acc) * m
         return out
