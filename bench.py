@@ -158,17 +158,19 @@ def _measure(platform: str) -> dict:
     try:
         with open(os.path.join(_REPO, "perf", "bench_data.json")) as f:
             ld = json.load(f)
-        if isinstance(ld, dict):
-            companions["loader_images_per_sec_per_host"] = ld.get("value")
+        if isinstance(ld, dict) and ld.get("value") is not None:
+            companions["loader_images_per_sec_per_host"] = ld["value"]
     except Exception:
         pass
     try:
         with open(os.path.join(_REPO, "perf", "fit_proof.json")) as f:
             fp = json.load(f)
         if isinstance(fp, dict):
-            companions["fit_loop_images_per_sec"] = fp.get(
-                "loop_images_per_sec_median_steady")
-            companions["fit_loop_vs_bench"] = fp.get("loop_vs_bench")
+            for src, dst in (("loop_images_per_sec_median_steady",
+                              "fit_loop_images_per_sec"),
+                             ("loop_vs_bench", "fit_loop_vs_bench")):
+                if fp.get(src) is not None:
+                    companions[dst] = fp[src]
     except Exception:
         pass
     return {

@@ -35,3 +35,28 @@ def test_no_class_weights_flag():
 def test_empty_milestones():
     args = cli.build_parser().parse_args(["--datadir", "/d", "--milestones"])
     assert cli.config_from_args(args).optim.milestones == ()
+
+
+def test_class_weights_auto_and_numeric():
+    import train as cli
+    p = cli.build_parser()
+    a = p.parse_args(["--datadir", "/d", "--class-weights", "auto"])
+    cfg = cli.config_from_args(a)
+    assert cfg.optim.auto_class_weights and cfg.optim.class_weights == ()
+    a = p.parse_args(["--datadir", "/d", "--class-weights", "1", "2.5"])
+    cfg = cli.config_from_args(a)
+    assert not cfg.optim.auto_class_weights
+    assert cfg.optim.class_weights == (1.0, 2.5)
+    a = p.parse_args(["--datadir", "/d"])  # reference default vector intact
+    cfg = cli.config_from_args(a)
+    assert cfg.optim.class_weights == (3.0, 3.0, 10.0, 1.0, 4.0, 4.0, 5.0)
+    a = p.parse_args(["--datadir", "/d", "--no-class-weights"])
+    assert cli.config_from_args(a).optim.class_weights == ()
+
+
+def test_class_weights_bad_token_clean_error():
+    import pytest
+    args = cli.build_parser().parse_args(
+        ["--datadir", "/d", "--class-weights", "auto", "2"])
+    with pytest.raises(SystemExit, match="class-weights"):
+        cli.config_from_args(args)

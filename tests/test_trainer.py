@@ -89,3 +89,60 @@ def test_collect_misclassified_ids(imagefolder, tmp_path, devices8):
     # Every id unique: padding duplicates must not leak in.
     assert len(set(trainer.last_misclassified)) == \
         len(trainer.last_misclassified)
+
+
+def test_auto_class_weights(tmp_path):
+    """--class-weights auto derives inverse-frequency weights from the
+    train fold; rarer classes get proportionally larger weights."""
+    import numpy as np
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+
+    root = str(tmp_path / "imb")
+    make_synthetic_imagefolder(root, classes=("rare",), per_class=4, size=24)
+    make_synthetic_imagefolder(root, classes=("common",), per_class=12,
+                               size=24)
+    cfg = Config(
+        data=DataConfig(data_dir=root, resize_size=24, batch_size=2),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="sgd", learning_rate=0.01,
+                          class_weights=(), auto_class_weights=True,
+                          milestones=()),
+        run=RunConfig(epochs=1, ckpt_dir=str(tmp_path / "ck"), resume=False),
+        mesh=MeshConfig(),
+    )
+    trainer = Trainer(cfg)
+    w = dict(zip(trainer.train_ds.classes, trainer.cfg.optim.class_weights))
+    # classes sorted: common(12) -> idx 0, rare(4) -> idx 1; N=16, K=2.
+    assert w["common"] == pytest.approx(16 / (2 * 12), abs=1e-5)
+    assert w["rare"] == pytest.approx(16 / (2 * 4), abs=1e-5)
+    assert w["rare"] > w["common"]
+    # The derived weights flow into the jitted step (finite weighted loss).
+    batch = next(iter(trainer.train_loader.epoch(0)))
+    _, m = trainer.train_step(
+        trainer.state, {k: batch[k] for k in ("image", "label", "mask")})
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_auto_class_weights_pads_to_model_head(tmp_path):
+    """--num-classes wider than the fold's class count: absent classes get
+    weight 1.0 instead of a trace-time shape error."""
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    root = str(tmp_path / "pad")
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=8,
+                               size=24)
+    cfg = Config(
+        data=DataConfig(data_dir=root, resize_size=24, batch_size=2),
+        model=ModelConfig(name="resnet18-cifar", num_classes=4,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="sgd", learning_rate=0.01,
+                          class_weights=(), auto_class_weights=True,
+                          milestones=()),
+        run=RunConfig(epochs=1, ckpt_dir=str(tmp_path / "ck"), resume=False),
+        mesh=MeshConfig(),
+    )
+    trainer = Trainer(cfg)
+    w = trainer.cfg.optim.class_weights
+    assert len(w) == 4
+    assert w[2] == 1.0 and w[3] == 1.0
+    assert w[0] == w[1] == 1.0  # balanced present classes -> ~1 each

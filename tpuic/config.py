@@ -119,6 +119,11 @@ class OptimConfig:
     # Class weights for CrossEntropy; reference hard-codes a 7-class imbalance
     # vector (train.py:157-158). Empty => unweighted.
     class_weights: Sequence[float] = (3.0, 3.0, 10.0, 1.0, 4.0, 4.0, 5.0)
+    # Derive inverse-frequency weights from the train fold's class counts
+    # (w_c = N / (K * n_c), mean ~1) at Trainer construction — what the
+    # reference's hard-coded vector approximated by hand for its original
+    # 7-class dataset. Overrides class_weights.
+    auto_class_weights: bool = False
     weight_decay: float = 0.0
     # LARS settings for the large-batch config (BASELINE.md config 5).
     lars_momentum: float = 0.9

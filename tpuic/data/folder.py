@@ -96,6 +96,12 @@ class ImageFolderDataset:
         path, _ = self.samples[index]
         return os.path.splitext(os.path.basename(path))[0]
 
+    def class_counts(self) -> np.ndarray:
+        """[num_classes] int64 sample count per class id."""
+        labels = np.asarray([lb for _, lb in self.samples])
+        return np.bincount(labels[labels >= 0],
+                           minlength=self.num_classes).astype(np.int64)
+
     def load(self, index: int, rng: Optional[np.random.Generator] = None
              ) -> Tuple[np.ndarray, int, str]:
         """Decode → RGB → resize → [augment] → normalize. Returns

@@ -178,6 +178,11 @@ class PackedDataset:
     def label(self, index: int) -> int:
         return int(self._labels[index])
 
+    def class_counts(self) -> np.ndarray:
+        """[num_classes] int64 sample count per class id."""
+        return np.bincount(self._labels[self._labels >= 0],
+                           minlength=self.num_classes).astype(np.int64)
+
     def raw(self, index: int) -> np.ndarray:
         return self._mm[index]
 

@@ -21,6 +21,7 @@ computes it over the global batch, so no extra logging collective exists.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Optional
@@ -83,8 +84,32 @@ class Trainer:
         num_classes = cfg.model.num_classes or self.train_ds.num_classes
         mcfg = cfg.model
         if num_classes != mcfg.num_classes:
-            import dataclasses
             mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
+        if cfg.optim.auto_class_weights:
+            # Inverse-frequency CE weights from the train fold (what the
+            # reference's hand-tuned [3,3,10,1,4,4,5] approximated for its
+            # own dataset): w_c = N / (K_present * n_c), mean ~1 over the
+            # classes that actually occur. Sized by the RESOLVED head width
+            # so an explicit --num-classes larger than the fold's class
+            # count pads with weight 1.0 instead of tracing a shape error.
+            counts = self.train_ds.class_counts()
+            if len(counts) > num_classes:
+                raise ValueError(
+                    f"auto class weights: train fold has {len(counts)} "
+                    f"classes but the model head is {num_classes} wide")
+            counts = np.concatenate(
+                [counts, np.zeros(num_classes - len(counts), np.int64)])
+            w = np.ones(num_classes, np.float64)
+            present = counts > 0
+            w[present] = counts.sum() / (present.sum() * counts[present])
+            cfg = dataclasses.replace(cfg, optim=dataclasses.replace(
+                cfg.optim,
+                class_weights=tuple(round(float(x), 6) for x in w)))
+            self.cfg = cfg
+            host0_print("[weights] auto class weights: "
+                        + ", ".join(f"{c}={x:.3f}" for c, x in
+                                    zip(self.train_ds.classes,
+                                        cfg.optim.class_weights)))
         self.model = create_model_from_config(mcfg, mesh=self.mesh)
         steps = max(1, self.train_loader.steps_per_epoch())
         self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs)

@@ -53,9 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="accumulate gradients over K steps before one "
                         "optimizer update (effective batch = K * global)")
-    p.add_argument("--class-weights", type=float, nargs="*",
-                   default=[3, 3, 10, 1, 4, 4, 5],
-                   help="CE class weights (reference train.py:157)")
+    p.add_argument("--class-weights", type=str, nargs="*",
+                   default=["3", "3", "10", "1", "4", "4", "5"],
+                   help="CE class weights (reference train.py:157), or the "
+                        "single word 'auto' to derive inverse-frequency "
+                        "weights from the train fold's class counts")
     p.add_argument("--no-class-weights", action="store_true")
     p.add_argument("--ckpt-dir", default="dtmodel/cp")
     p.add_argument("--save-period", type=int, default=5)
@@ -100,7 +102,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> Config:
-    weights = () if args.no_class_weights else tuple(args.class_weights)
+    auto_weights = (not args.no_class_weights
+                    and list(args.class_weights) == ["auto"])
+    if args.no_class_weights or auto_weights:
+        weights = ()
+    else:
+        try:
+            weights = tuple(float(w) for w in args.class_weights)
+        except ValueError:
+            raise SystemExit(
+                "train.py: error: --class-weights expects numbers or the "
+                f"single word 'auto' (got {args.class_weights!r})")
     return Config(
         data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
                         batch_size=args.batchsize, num_workers=args.workers,
@@ -111,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
                           class_weights=weights,
+                          auto_class_weights=auto_weights,
                           weight_decay=args.weight_decay,
                           warmup_epochs=args.warmup_epochs,
                           grad_accum_steps=args.grad_accum_steps),
