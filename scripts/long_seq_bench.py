@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Long-sequence attention bench: dense vs Pallas flash at growing N.
+
+The round-3 smoke (perf/pallas_smoke.json) showed flash LOSES to dense at
+ViT-B's N=197 — its value is O(N*D) HBM at long sequence lengths. This
+script quantifies the crossover on the real chip: ViT-B/16 train step at
+224/384/512px (N = 197/577/1025 tokens) with attention='dense' vs 'flash',
+recording step time and peak memory. Writes perf/long_seq.json.
+
+Usage: python scripts/long_seq_bench.py [--sizes 224,384,512] [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def measure(size: int, attention: str, batch: int, n_steps: int = 10):
+    import jax
+
+    from tpuic.config import ModelConfig, OptimConfig
+    from tpuic.data.synthetic import synthetic_batch
+    from tpuic.models import create_model
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+    from tpuic.train.step import make_train_step
+
+    mcfg = ModelConfig(name="vit-b16", num_classes=1000, dtype="bfloat16",
+                       attention=attention)
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=())
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype,
+                         attention=attention)
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (batch, size, size, 3))
+    data = synthetic_batch(batch, size, mcfg.num_classes)
+    data = {k: jax.device_put(v) for k, v in data.items()}
+    step = make_train_step(ocfg, mcfg, None, donate=True)
+    state, m = step(state, data)
+    float(m["loss"])  # force completion (tunnel-safe sync)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = step(state, data)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / n_steps
+    mem = None
+    try:
+        ms = jax.devices()[0].memory_stats()
+        mem = round(ms.get("peak_bytes_in_use", 0) / (1 << 20))
+    except Exception:
+        pass
+    n_tokens = (size // 16) ** 2 + 1
+    return {"size": size, "tokens": n_tokens, "attention": attention,
+            "step_ms": round(1000 * dt, 2), "peak_mem_mb": mem,
+            "images_per_sec": round(batch / dt, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="224,384,512")
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
+    if is_tunneled() and not tpu_reachable(150):
+        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
+        return 2
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, "tests", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    rows = []
+    for size in (int(s) for s in args.sizes.split(",")):
+        for attention in ("dense", "flash"):
+            r = measure(size, attention, args.batch)
+            r["platform"] = jax.devices()[0].platform
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+    out = {"batch": args.batch, "model": "vit-b16",
+           "device": getattr(jax.devices()[0], "device_kind", "?"),
+           "rows": rows}
+    with open(os.path.join(_REPO, "perf", "long_seq.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print("wrote perf/long_seq.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
