@@ -7,6 +7,12 @@ script quantifies the crossover on the real chip: ViT-B/16 train step at
 224/384/512px (N = 197/577/1025 tokens) with attention='dense' vs 'flash',
 recording step time and peak memory. Writes perf/long_seq.json.
 
+Each (size, attention) config runs in its OWN subprocess:
+``peak_bytes_in_use`` is a process-lifetime high-water mark, so measuring
+several configs in one process would floor every later number at the
+earlier peak and erase exactly the dense-vs-flash memory difference this
+bench exists to show.
+
 Usage: python scripts/long_seq_bench.py [--sizes 224,384,512] [--batch 32]
 """
 
@@ -15,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -24,6 +31,11 @@ sys.path.insert(0, _REPO)
 
 def measure(size: int, attention: str, batch: int, n_steps: int = 10):
     import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, "tests", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     from tpuic.config import ModelConfig, OptimConfig
     from tpuic.data.synthetic import synthetic_batch
@@ -59,36 +71,51 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10):
     n_tokens = (size // 16) ** 2 + 1
     return {"size": size, "tokens": n_tokens, "attention": attention,
             "step_ms": round(1000 * dt, 2), "peak_mem_mb": mem,
-            "images_per_sec": round(batch / dt, 1)}
+            "images_per_sec": round(batch / dt, 1),
+            "platform": jax.devices()[0].platform}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", default="224,384,512")
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--_child", nargs=2, metavar=("SIZE", "ATTENTION"),
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args._child:
+        size, attention = int(args._child[0]), args._child[1]
+        print(json.dumps(measure(size, attention, args.batch)), flush=True)
+        return 0
 
     from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
     if is_tunneled() and not tpu_reachable(150):
         print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
         return 2
 
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(_REPO, "tests", ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
     rows = []
     for size in (int(s) for s in args.sizes.split(",")):
         for attention in ("dense", "flash"):
-            r = measure(size, attention, args.batch)
-            r["platform"] = jax.devices()[0].platform
-            rows.append(r)
-            print(json.dumps(r), flush=True)
-    out = {"batch": args.batch, "model": "vit-b16",
-           "device": getattr(jax.devices()[0], "device_kind", "?"),
-           "rows": rows}
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--batch", str(args.batch), "--_child", str(size),
+                 attention],
+                capture_output=True, text=True, cwd=_REPO, timeout=900)
+            row = None
+            for line in reversed((proc.stdout or "").strip().splitlines()):
+                try:
+                    row = json.loads(line)
+                    break
+                except (json.JSONDecodeError, ValueError):
+                    continue
+            if row is None:
+                tail = " | ".join(
+                    (proc.stderr or "").strip().splitlines()[-2:])
+                row = {"size": size, "attention": attention,
+                       "error": f"rc={proc.returncode}: {tail[:300]}"}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    out = {"batch": args.batch, "model": "vit-b16", "rows": rows}
     with open(os.path.join(_REPO, "perf", "long_seq.json"), "w") as f:
         json.dump(out, f, indent=2)
     print("wrote perf/long_seq.json")

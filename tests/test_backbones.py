@@ -99,3 +99,25 @@ def test_train_step_with_inception_aux_loss():
     new_state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
     assert int(new_state.step) == 1
+
+
+def test_efficientnet_b4_b7_registered_and_scaled():
+    """b4-b7 compound scaling: registered, and widths/depths grow per the
+    published coefficients (feature width = round_filters(1280, w))."""
+    from tpuic.models import available_models
+    from tpuic.models.efficientnet import _SCALING, _round_filters
+
+    for v in ("b4", "b5", "b6", "b7"):
+        assert f"efficientnet-{v}" in available_models()
+    # b4 forward (the largest we trace in CI): feature width 1792.
+    model = create_model("efficientnet-b4", 5, dtype="float32")
+    import jax
+    import numpy as np
+    variables = model.init(jax.random.key(0), np.zeros((1, 64, 64, 3),
+                                                       np.float32),
+                           train=False)
+    out = model.apply(variables, np.zeros((2, 64, 64, 3), np.float32),
+                      train=False)
+    assert out.shape == (2, 5)
+    assert _round_filters(1280, _SCALING["b4"][0]) == 1792
+    assert _round_filters(1280, _SCALING["b7"][0]) == 2560

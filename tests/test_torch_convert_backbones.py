@@ -180,3 +180,23 @@ def test_efficientnet_mlp_head_keys_convert():
     tree = convert_efficientnet(sd, variant="b0")
     assert set(tree["params"]["head"]) == {"fc0", "out"}
     assert tree["params"]["head"]["out"]["kernel"].shape == (128, 7)
+
+
+def test_efficientnet_b4_forward_parity():
+    """Compound scaling generalizes: a b4 torch state_dict auto-detects,
+    converts, and matches logits (the b0 parity test at the next scale)."""
+    from tpuic.checkpoint.torch_convert import detect_efficientnet_variant
+    torch = pytest.importorskip("torch")
+    tm = build_efficientnet('b4', num_classes=5).eval()
+    assert detect_efficientnet_variant(tm.state_dict()) == "b4"
+    tree = convert_efficientnet(tm.state_dict(), variant="b4")
+    model = create_model("efficientnet-b4", 5, head_widths=(),
+                         dtype="float32")
+    x = np.random.default_rng(4).standard_normal((2, 64, 64, 3)
+                                                 ).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(model.apply(
+        {"params": tree["params"], "batch_stats": tree["batch_stats"]},
+        x, train=False))
+    np.testing.assert_allclose(got, ref, atol=2e-4)

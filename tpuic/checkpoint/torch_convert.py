@@ -307,7 +307,7 @@ def _effnet_block_coords(variant: str):
 
 
 def detect_efficientnet_variant(state_dict: Mapping[str, Any]) -> str:
-    """Infer b0..b3 from the checkpoint itself.
+    """Infer b0..b7 from the checkpoint itself.
 
     The flat block count separates b0 (16) and b3 (26); b1 and b2 both have
     23 blocks, so they are disambiguated by the final block's projection
@@ -324,7 +324,7 @@ def detect_efficientnet_variant(state_dict: Mapping[str, Any]) -> str:
                   if len(_effnet_block_coords(v)) == n_blocks]
     if not candidates:
         raise ValueError(f"no known efficientnet variant has {n_blocks} "
-                         f"blocks (b0..b3 supported)")
+                         f"blocks (b0..b7 supported)")
     if len(candidates) > 1:
         proj = sd.get(f"_blocks.{n_blocks - 1}._project_conv.weight")
         if proj is not None:
@@ -339,7 +339,7 @@ def convert_efficientnet(state_dict: Mapping[str, Any], variant: str = "b3",
                          head_scope: str = "head") -> Dict[str, Dict]:
     """efficientnet_pytorch state_dict -> ``{'params', 'batch_stats'}``.
 
-    ``variant`` ('b0'..'b3') resolves the flat ``_blocks.{i}`` index into the
+    ``variant`` ('b0'..'b7') resolves the flat ``_blocks.{i}`` index into the
     tpuic ``block{stage}_{repeat}`` name (depth multiplier dependent). The
     package's ``_fc`` single Linear maps to ``head/out``; a reference-style
     MLP (``fc.0/2/4/6``) maps to the full head.
@@ -438,7 +438,7 @@ def convert_state_dict(state_dict: Mapping[str, Any],
                        arch: str = "auto", **kw) -> Dict[str, Dict]:
     """Convert any supported torch state_dict to tpuic trees.
 
-    ``arch``: 'auto' | 'resnet*' | 'inceptionv3' | 'efficientnet-b{0..3}'.
+    ``arch``: 'auto' | 'resnet*' | 'inceptionv3' | 'efficientnet-b{0..7}'.
     """
     if arch == "auto":
         arch = detect_arch(state_dict)

@@ -326,7 +326,9 @@ _EFFNET_BASE_BLOCKS = ((1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
                        (6, 320, 1, 1, 3))
 # (width_coefficient, depth_coefficient) per variant.
 _EFFNET_COEF = {"b0": (1.0, 1.0), "b1": (1.0, 1.1),
-                "b2": (1.1, 1.2), "b3": (1.2, 1.4)}
+                "b2": (1.1, 1.2), "b3": (1.2, 1.4),
+                "b4": (1.4, 1.8), "b5": (1.6, 2.2),
+                "b6": (1.8, 2.6), "b7": (2.0, 3.1)}
 
 
 def _round_filters(filters: int, width: float, divisor: int = 8) -> int:
@@ -428,7 +430,7 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7):
 def build_reference_model(arch: str, num_classes: int = 7):
     """Replica of the reference ``Classifier(name, n)`` for a backbone name
     (nn/classifier.py:8-34). arch: resnet18/34/50/101, inceptionv3,
-    efficientnet-b{0..3}."""
+    efficientnet-b{0..7}."""
     if arch in _RESNET_CFG:
         return build_resnet(arch, num_classes)
     if arch.startswith("inception"):

@@ -101,7 +101,7 @@ def _register_builtins():
                                         bn_momentum=bn_momentum)
         return make
 
-    for v in ("b0", "b1", "b2", "b3"):
+    for v in ("b0", "b1", "b2", "b3", "b4", "b5", "b6", "b7"):
         register(f"efficientnet-{v}", _eff(v))
 
     def _vit_factory(ctor):

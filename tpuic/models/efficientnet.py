@@ -1,4 +1,4 @@
-"""EfficientNet family (B0-B3) as Flax modules.
+"""EfficientNet family (B0-B7) as Flax modules.
 
 Capability parity with the reference's 'efficientnet-b3' branch
 (nn/classifier.py:17-18, via the efficientnet_pytorch package) and the
@@ -35,12 +35,17 @@ _BASE_BLOCKS: Tuple[Tuple[int, int, int, int, int], ...] = (
     (6, 320, 1, 1, 3),
 )
 
-# name -> (width_mult, depth_mult, dropout)
+# name -> (width_mult, depth_mult, dropout) — the published compound-scaling
+# coefficients (EfficientNet paper, Table; matches efficientnet_pytorch).
 _SCALING = {
     "b0": (1.0, 1.0, 0.2),
     "b1": (1.0, 1.1, 0.2),
     "b2": (1.1, 1.2, 0.3),
     "b3": (1.2, 1.4, 0.3),
+    "b4": (1.4, 1.8, 0.4),
+    "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5),
+    "b7": (2.0, 3.1, 0.5),
 }
 
 
