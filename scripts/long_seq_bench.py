@@ -72,7 +72,8 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10):
     return {"size": size, "tokens": n_tokens, "attention": attention,
             "step_ms": round(1000 * dt, 2), "peak_mem_mb": mem,
             "images_per_sec": round(batch / dt, 1),
-            "platform": jax.devices()[0].platform}
+            "platform": jax.devices()[0].platform,
+            "device": getattr(jax.devices()[0], "device_kind", "?")}
 
 
 def main():
@@ -96,11 +97,18 @@ def main():
     rows = []
     for size in (int(s) for s in args.sizes.split(",")):
         for attention in ("dense", "flash"):
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--batch", str(args.batch), "--_child", str(size),
-                 attention],
-                capture_output=True, text=True, cwd=_REPO, timeout=900)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--batch", str(args.batch), "--_child", str(size),
+                     attention],
+                    capture_output=True, text=True, cwd=_REPO, timeout=900)
+            except subprocess.TimeoutExpired:
+                row = {"size": size, "attention": attention,
+                       "error": "timed out after 900s"}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                continue
             row = None
             for line in reversed((proc.stdout or "").strip().splitlines()):
                 try:

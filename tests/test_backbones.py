@@ -121,3 +121,32 @@ def test_efficientnet_b4_b7_registered_and_scaled():
     assert out.shape == (2, 5)
     assert _round_filters(1280, _SCALING["b4"][0]) == 1792
     assert _round_filters(1280, _SCALING["b7"][0]) == 2560
+
+
+def test_resnet152_and_vit_l16_registered():
+    from tpuic.models import available_models
+    assert "resnet152" in available_models()
+    assert "vit-l16" in available_models()
+    # Shape-check resnet152 at tiny resolution (vit-l16 is too heavy for
+    # CI tracing; its ctor params are pinned instead).
+    import jax
+    import numpy as np
+    model = create_model("resnet152", 3, dtype="float32")
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    out = model.apply(variables, np.zeros((2, 32, 32, 3), np.float32),
+                      train=False, mutable=False)
+    assert out.shape == (2, 3)
+    from tpuic.models.vit import vit_l16
+    m = vit_l16()
+    assert (m.hidden, m.depth, m.num_heads) == (1024, 24, 16)
+
+
+def test_detect_resnet152_depth():
+    from tpuic.checkpoint.torch_convert import detect_resnet_depth
+    sd = {"layer1.0.conv3.weight": 0}
+    sd.update({f"layer3.{i}.conv1.weight": 0 for i in range(36)})
+    assert detect_resnet_depth(sd) == "resnet152"
+    sd23 = {"layer1.0.conv3.weight": 0}
+    sd23.update({f"layer3.{i}.conv1.weight": 0 for i in range(23)})
+    assert detect_resnet_depth(sd23) == "resnet101"

@@ -423,13 +423,15 @@ def detect_arch(state_dict: Mapping[str, Any]) -> str:
 
 
 def detect_resnet_depth(state_dict: Mapping[str, Any]) -> str:
-    """'resnet{18,34,50,101}' from block kind + layer3 block count."""
+    """'resnet{18,34,50,101,152}' from block kind + layer3 block count."""
     flat = strip_prefixes(state_dict)
     bottleneck = any(k.startswith("layer1.0.conv3") for k in flat)
     blocks = {int(m.group(1)) for k in flat
               if (m := re.match(r"layer3\.(\d+)\.", k))}
     n3 = (max(blocks) + 1) if blocks else 0
     if bottleneck:
+        if n3 >= 36:
+            return "resnet152"
         return "resnet101" if n3 >= 23 else "resnet50"
     return "resnet34" if n3 >= 6 else "resnet18"
 

@@ -46,6 +46,7 @@ _RESNET_CFG = {
     "resnet34": ("basic", (3, 4, 6, 3)),
     "resnet50": ("bottleneck", (3, 4, 6, 3)),
     "resnet101": ("bottleneck", (3, 4, 23, 3)),
+    "resnet152": ("bottleneck", (3, 8, 36, 3)),
 }
 
 
@@ -429,7 +430,7 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7):
 
 def build_reference_model(arch: str, num_classes: int = 7):
     """Replica of the reference ``Classifier(name, n)`` for a backbone name
-    (nn/classifier.py:8-34). arch: resnet18/34/50/101, inceptionv3,
+    (nn/classifier.py:8-34). arch: resnet18/34/50/101/152, inceptionv3,
     efficientnet-b{0..7}."""
     if arch in _RESNET_CFG:
         return build_resnet(arch, num_classes)

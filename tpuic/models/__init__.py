@@ -86,6 +86,7 @@ def _register_builtins():
     register("resnet34", _rn(_resnet.resnet34))
     register("resnet50", _rn(_resnet.resnet50))
     register("resnet101", _rn(_resnet.resnet101))
+    register("resnet152", _rn(_resnet.resnet152))
     register("resnet18-cifar", _rn(_resnet.resnet18, small_stem=True))
     # MLPerf-style space-to-depth stem: identical math to resnet50 (the
     # 7x7/s2 stem re-indexed as 4x4/s1 on [H/2,W/2,12]), better MXU layout;
@@ -113,6 +114,7 @@ def _register_builtins():
         return make
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
+    register("vit-l16", _vit_factory(_vit.vit_l16))
     register("vit-s16", _vit_factory(_vit.vit_s16))
     register("vit-tiny", _vit_factory(_vit.vit_tiny))
     # Switch-MoE variants (models/moe.py): expert-parallel over the mesh

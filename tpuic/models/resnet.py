@@ -169,3 +169,7 @@ def resnet50(**kw) -> ResNet:
 
 def resnet101(**kw) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 23, 3), block=Bottleneck, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), block=Bottleneck, **kw)

@@ -181,6 +181,10 @@ def vit_b16(**kw) -> ViT:
     return ViT(patch=16, hidden=768, depth=12, num_heads=12, **kw)
 
 
+def vit_l16(**kw) -> ViT:
+    return ViT(patch=16, hidden=1024, depth=24, num_heads=16, **kw)
+
+
 def vit_s16(**kw) -> ViT:
     return ViT(patch=16, hidden=384, depth=12, num_heads=6, **kw)
 
