@@ -200,3 +200,47 @@ def test_efficientnet_b4_forward_parity():
         {"params": tree["params"], "batch_stats": tree["batch_stats"]},
         x, train=False))
     np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_efficientnet_export_roundtrip():
+    """tpuic -> torch export is the exact inverse of the conversion: a
+    b1 replica's state_dict survives convert -> export bit-for-bit."""
+    from tpuic.checkpoint.torch_convert import (convert_efficientnet,
+                                                export_efficientnet)
+    torch = pytest.importorskip("torch")
+    tm = build_efficientnet('b1', num_classes=5)
+    sd0 = {k: v.numpy() for k, v in tm.state_dict().items()}
+    tree = convert_efficientnet(tm.state_dict(), variant="b1")
+    sd1 = export_efficientnet(tree["params"], tree["batch_stats"],
+                              prefix="")
+    missing = {k for k in sd0 if "num_batches_tracked" not in k} - set(sd1)
+    assert not missing, f"export dropped keys: {sorted(missing)[:8]}"
+    for k, v in sd1.items():
+        if "num_batches_tracked" in k:
+            continue
+        np.testing.assert_array_equal(v, sd0[k], err_msg=k)
+    # The exported dict loads straight back into the torch replica.
+    tm.load_state_dict({k: torch.as_tensor(np.asarray(v))
+                        for k, v in sd1.items()})
+
+
+def test_efficientnet_mlp_head_replica_roundtrip():
+    """MLP-head effnet (reference-style head): replica(mlp_head=True) state
+    round-trips convert -> export, and --verify's replica can load it."""
+    from tpuic.checkpoint.torch_convert import (convert_efficientnet,
+                                                export_efficientnet,
+                                                _infer_head)
+    torch = pytest.importorskip("torch")
+    tm = build_efficientnet('b0', num_classes=5, mlp_head=True)
+    sd0 = {k: v.numpy() for k, v in tm.state_dict().items()}
+    n, mlp = _infer_head(sd0)
+    assert (n, mlp) == (5, True)
+    tree = convert_efficientnet(tm.state_dict(), variant="b0")
+    assert "fc0" in tree["params"]["head"] and "out" in tree["params"]["head"]
+    sd1 = export_efficientnet(tree["params"], tree["batch_stats"], prefix="")
+    for k, v in sd0.items():
+        if "num_batches_tracked" in k:
+            continue
+        np.testing.assert_array_equal(sd1[k], v, err_msg=k)
+    tm.load_state_dict({k: torch.as_tensor(np.asarray(v))
+                        for k, v in sd1.items()})

@@ -536,6 +536,17 @@ def _conv_inv(w) -> np.ndarray:
 _RESNET_LEAF_INV = {v: k for k, v in _RESNET_LEAF.items()}
 
 
+def _put_bn_inv(sd: Dict[str, np.ndarray], tname: str,
+                p: Mapping, s: Mapping) -> None:
+    """Emit one BatchNorm's torch keys from tpuic params/stats subtrees
+    (shared by every exporter; num_batches_tracked re-synthesized as 0)."""
+    sd[f"{tname}.weight"] = _unbox(p["scale"])
+    sd[f"{tname}.bias"] = _unbox(p["bias"])
+    sd[f"{tname}.running_mean"] = _unbox(s["mean"])
+    sd[f"{tname}.running_var"] = _unbox(s["var"])
+    sd[f"{tname}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+
 def _export_head(head: Mapping[str, Any]) -> Dict[str, np.ndarray]:
     """tpuic head/{fc0..,out} -> fc.{0,2,4,...} Sequential keys (ReLUs take
     the odd slots), or the plain torchvision 'fc' for a single Linear."""
@@ -572,13 +583,7 @@ def export_resnet(params: Mapping[str, Any], batch_stats: Mapping[str, Any],
             f"not a resnet checkpoint (got {sorted(bb)[:6]}...); only the "
             "resnet family exports to the torch layout")
     sd: Dict[str, np.ndarray] = {}
-
-    def put_bn(torch_name: str, p: Mapping, s: Mapping) -> None:
-        sd[f"{torch_name}.weight"] = _unbox(p["scale"])
-        sd[f"{torch_name}.bias"] = _unbox(p["bias"])
-        sd[f"{torch_name}.running_mean"] = _unbox(s["mean"])
-        sd[f"{torch_name}.running_var"] = _unbox(s["var"])
-        sd[f"{torch_name}.num_batches_tracked"] = np.asarray(0, np.int64)
+    put_bn = lambda tname, p, s: _put_bn_inv(sd, tname, p, s)  # noqa: E731
 
     for name, sub in bb.items():
         if name == "conv1":
@@ -620,11 +625,7 @@ def export_inception(params: Mapping[str, Any],
 
     def put_convbn(tname: str, p: Mapping, s: Mapping) -> None:
         sd[f"{tname}.conv.weight"] = _conv_inv(p["conv"]["kernel"])
-        sd[f"{tname}.bn.weight"] = _unbox(p["bn"]["scale"])
-        sd[f"{tname}.bn.bias"] = _unbox(p["bn"]["bias"])
-        sd[f"{tname}.bn.running_mean"] = _unbox(s["bn"]["mean"])
-        sd[f"{tname}.bn.running_var"] = _unbox(s["bn"]["var"])
-        sd[f"{tname}.bn.num_batches_tracked"] = np.asarray(0, np.int64)
+        _put_bn_inv(sd, f"{tname}.bn", p["bn"], s["bn"])
 
     for name, sub in bb.items():
         if name in stem_inv:
@@ -649,6 +650,64 @@ def export_inception(params: Mapping[str, Any],
     return {prefix + k: v for k, v in sd.items()}
 
 
+def export_efficientnet(params: Mapping[str, Any],
+                        batch_stats: Mapping[str, Any],
+                        prefix: str = "module.encoder."
+                        ) -> Dict[str, np.ndarray]:
+    """tpuic EfficientNet trees -> efficientnet_pytorch-layout state_dict.
+
+    The inverse of ``convert_efficientnet``. The flat ``_blocks.{i}`` index
+    is reconstructed by enumerating ``block{stage}_{repeat}`` names in
+    (stage, repeat) order, so no variant knowledge is needed — any b0..b7
+    tree round-trips. A single-Linear head exports as the package's
+    ``_fc``; a reference-style MLP head exports as ``fc.{0,2,...}``.
+    """
+    bb = params.get("backbone", {})
+    bs = batch_stats.get("backbone", {})
+    if "stem_conv" not in bb or not any(n.startswith("block") for n in bb):
+        raise ValueError(
+            "export_efficientnet: params['backbone'] has no stem_conv/"
+            f"block* modules — not an efficientnet checkpoint "
+            f"(got {sorted(bb)[:6]}...)")
+    conv_inv = {v: k for k, v in _EFFNET_BLOCK_CONV.items()}
+    bn_inv = {v: k for k, v in _EFFNET_BLOCK_BN.items()}
+    se_inv = {v: k for k, v in _EFFNET_SE.items()}
+    sd: Dict[str, np.ndarray] = {}
+    put_bn = lambda tname, p, s: _put_bn_inv(sd, tname, p, s)  # noqa: E731
+
+    def coord_key(name: str) -> Tuple[int, int]:
+        stage, rep = name[len("block"):].split("_")
+        return int(stage), int(rep)
+
+    blocks = sorted((n for n in bb if re.fullmatch(r"block\d+_\d+", n)),
+                    key=coord_key)
+    for i, name in enumerate(blocks):
+        sub, stats = bb[name], bs.get(name, {})
+        for mod, leaves in sub.items():
+            if mod in conv_inv:
+                sd[f"_blocks.{i}.{conv_inv[mod]}.weight"] = _conv_inv(
+                    leaves["kernel"])
+            elif mod in bn_inv:
+                put_bn(f"_blocks.{i}.{bn_inv[mod]}", leaves, stats[mod])
+            elif mod == "se":
+                for part, tpart in se_inv.items():
+                    sd[f"_blocks.{i}.{tpart}.weight"] = _conv_inv(
+                        leaves[part]["kernel"])
+                    sd[f"_blocks.{i}.{tpart}.bias"] = _unbox(
+                        leaves[part]["bias"])
+    sd["_conv_stem.weight"] = _conv_inv(bb["stem_conv"]["kernel"])
+    put_bn("_bn0", bb["stem_bn"], bs["stem_bn"])
+    sd["_conv_head.weight"] = _conv_inv(bb["head_conv"]["kernel"])
+    put_bn("_bn1", bb["head_bn"], bs["head_bn"])
+    head = params.get("head", {})
+    if any(re.fullmatch(r"fc\d+", m) for m in head):
+        sd.update(_export_head(head))      # reference MLP -> fc.{0,2,...}
+    elif "out" in head:
+        sd["_fc.weight"] = np.transpose(_unbox(head["out"]["kernel"]))
+        sd["_fc.bias"] = _unbox(head["out"]["bias"])
+    return {prefix + k: v for k, v in sd.items()}
+
+
 def export_state_dict(params: Mapping[str, Any],
                       batch_stats: Mapping[str, Any],
                       prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
@@ -658,9 +717,12 @@ def export_state_dict(params: Mapping[str, Any],
         return export_resnet(params, batch_stats, prefix)
     if "mixed5b" in bb:
         return export_inception(params, batch_stats, prefix)
+    if "stem_conv" in bb:
+        return export_efficientnet(params, batch_stats, prefix)
     raise ValueError(
         "export_state_dict: unsupported backbone for torch export "
-        f"(got {sorted(bb)[:6]}...); supported: resnet*, inceptionv3")
+        f"(got {sorted(bb)[:6]}...); supported: resnet*, inceptionv3, "
+        "efficientnet-b*")
 
 
 # ---------------------------------------------------------------------------
@@ -705,8 +767,9 @@ def main(argv=None) -> int:
                     "print max logits delta")
     ap.add_argument("--export-torch", metavar="OUT", default="",
                     help="INVERSE direction: read a tpuic Orbax checkpoint "
-                    "and write a reference-layout torch file (resnet + "
-                    "inceptionv3 families) to OUT; composes with --verify")
+                    "and write a reference-layout torch file (resnet, "
+                    "inceptionv3, efficientnet families) to OUT; composes "
+                    "with --verify")
     ap.add_argument("--image-size", type=int, default=128)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--tol", type=float, default=1e-3,
@@ -774,7 +837,8 @@ def main(argv=None) -> int:
     from tpuic.checkpoint.torch_ref import build_reference_model
     from tpuic.models import create_model
 
-    replica = build_reference_model(arch, num_classes).eval()
+    replica = build_reference_model(arch, num_classes,
+                                    mlp_head=mlp_head).eval()
     # strip_prefixes normalizes to numpy for the converter; torch's
     # load_state_dict wants tensors back.
     stripped = {k: torch.as_tensor(np.asarray(v))

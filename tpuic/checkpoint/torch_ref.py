@@ -137,7 +137,8 @@ def build_resnet(arch: str, num_classes: int = 7, mlp_head: bool = True):
 # Inception-v3 (torchvision naming)
 # ---------------------------------------------------------------------------
 
-def build_inception(num_classes: int = 7, aux: bool = True):
+def build_inception(num_classes: int = 7, aux: bool = True,
+                    mlp_head: bool = True):
     torch, tnn, F = _torch()
 
     class BasicConv2d(tnn.Module):
@@ -297,7 +298,8 @@ def build_inception(num_classes: int = 7, aux: bool = True):
             self.Mixed_7a = InceptionD(768)
             self.Mixed_7b = InceptionE(1280)
             self.Mixed_7c = InceptionE(2048)
-            self.fc = reference_mlp_head(2048, num_classes)
+            self.fc = (reference_mlp_head(2048, num_classes)
+                       if mlp_head else tnn.Linear(2048, num_classes))
 
         def forward(self, x):
             x = self.Conv2d_1a_3x3(x)
@@ -344,13 +346,17 @@ def _round_repeats(repeats: int, depth: float) -> int:
     return int(math.ceil(depth * repeats))
 
 
-def build_efficientnet(variant: str = "b0", num_classes: int = 7):
+def build_efficientnet(variant: str = "b0", num_classes: int = 7,
+                       mlp_head: bool = False):
     """efficientnet_pytorch-named EfficientNet with its single-Linear _fc.
 
     Note the reference's efficientnet branch is broken upstream
     (nn/classifier.py:17-18+27 sets ``.fc`` on a model whose attr is
     ``._fc``); the package's own ``_fc`` head is replicated, which the
-    converter maps to ``head/out``."""
+    converter maps to ``head/out``. ``mlp_head=True`` replaces it with the
+    reference-style MLP Sequential at attribute ``fc`` (keys fc.{0,2,..})
+    — the layout tpuic's export emits for MLP-head efficientnet
+    checkpoints, so --export-torch --verify has a loadable replica."""
     torch, tnn, F = _torch()
     width, depth = _EFFNET_COEF[variant]
 
@@ -415,7 +421,10 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7):
             head = _round_filters(1280, width)
             self._conv_head = SameConv2d(inp, head, 1, bias=False)
             self._bn1 = tnn.BatchNorm2d(head, eps=1e-3)
-            self._fc = tnn.Linear(head, num_classes)
+            if mlp_head:
+                self.fc = reference_mlp_head(head, num_classes)
+            else:
+                self._fc = tnn.Linear(head, num_classes)
 
         def forward(self, x):
             x = swish(self._bn0(self._conv_stem(x)))
@@ -423,20 +432,24 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7):
                 x = b(x)
             x = swish(self._bn1(self._conv_head(x)))
             x = F.adaptive_avg_pool2d(x, 1).flatten(1)
-            return self._fc(x)
+            return self.fc(x) if mlp_head else self._fc(x)
 
     return EfficientNet()
 
 
-def build_reference_model(arch: str, num_classes: int = 7):
+def build_reference_model(arch: str, num_classes: int = 7,
+                          mlp_head: bool = True):
     """Replica of the reference ``Classifier(name, n)`` for a backbone name
     (nn/classifier.py:8-34). arch: resnet18/34/50/101/152, inceptionv3,
-    efficientnet-b{0..7}."""
+    efficientnet-b{0..7}. ``mlp_head`` selects the reference MLP head vs
+    the family's plain single-Linear head (torchvision fc /
+    efficientnet_pytorch _fc) — pass what _infer_head detected so --verify
+    builds a replica that can actually load the checkpoint."""
     if arch in _RESNET_CFG:
-        return build_resnet(arch, num_classes)
+        return build_resnet(arch, num_classes, mlp_head=mlp_head)
     if arch.startswith("inception"):
-        return build_inception(num_classes)
+        return build_inception(num_classes, mlp_head=mlp_head)
     if arch.startswith("efficientnet"):
         variant = arch.rsplit("-", 1)[-1] if "-" in arch else "b0"
-        return build_efficientnet(variant, num_classes)
+        return build_efficientnet(variant, num_classes, mlp_head=mlp_head)
     raise ValueError(f"no torch replica for arch '{arch}'")
