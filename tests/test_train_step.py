@@ -155,3 +155,32 @@ def test_weighted_ce_in_step_with_class_weights():
     batch = {k: jnp.asarray(v) for k, v in synthetic_batch(8, 32, 3).items()}
     _, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_sharded_top5_exact():
+    """Top-5 sums ride the same sharded reduction as top-1: 8-device mesh
+    equals a single-device numpy recomputation exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshConfig(), jax.devices())
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=7, dtype="float32")
+    ocfg = OptimConfig(class_weights=())
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (16, 24, 24, 3))
+    batch = synthetic_batch(16, 24, mcfg.num_classes)
+    batch["mask"][-3:] = 0.0  # padding rows must not count
+    sh = NamedSharding(mesh, P("data"))
+    dev_batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+    ev = make_eval_step(ocfg, mcfg, mesh)
+    m = ev(state, dev_batch)
+    assert "correct5" in m
+    # Recompute on host from the model's own logits.
+    logits = np.asarray(model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        batch["image"], train=False))
+    top5 = np.argsort(-logits, axis=-1)[:, :5]
+    hit = (top5 == batch["label"][:, None]).any(axis=1)
+    want = float((hit * batch["mask"]).sum())
+    assert float(m["correct5"]) == want
+    assert float(m["correct5"]) >= float(m["correct"])

@@ -199,6 +199,11 @@ class MeshConfig:
     # FSDP/ZeRO-3: shard large params + Adam moments over the data axis
     # (tpuic/parallel/sharding.py). False => replicated state, DDP semantics.
     fsdp: bool = False
+    # ZeRO-1 weight-update sharding (arXiv:2004.13336): params replicated
+    # (pure-DP forward, no weight gathers) but optimizer moments sharded
+    # over 'data' — 1/N Adam memory and update compute per device, one
+    # update all-gather per step. Subsumed by fsdp=True.
+    zero1: bool = False
     # Map models' logical 'model' axis onto the mesh model axis (Megatron TP).
     # Only meaningful when model > 1.
     tensor_parallel: bool = True

@@ -111,10 +111,24 @@ def state_partition_specs(state, mesh: Mesh, *, tp: bool = True,
 
 
 def state_shardings(state, mesh: Mesh, *, tp: bool = True, fsdp: bool = False,
+                    zero1: bool = False,
                     min_fsdp_size: int = 2 ** 12) -> Any:
-    """NamedSharding prefix tree for jit in/out_shardings."""
+    """NamedSharding prefix tree for jit in/out_shardings.
+
+    ``zero1`` (weight-update/optimizer-state sharding, the ZeRO-1 point of
+    the ZeRO family and the subject of arXiv:2004.13336): parameters stay
+    replicated — DDP semantics, no weight all-gathers in the forward — but
+    the optimizer moments shard over ``data``, so each device stores 1/N of
+    the Adam state and computes 1/N of the weight update; GSPMD inserts one
+    all-gather of the *update* (not the weights) per step. Ignored when
+    full FSDP is on (ZeRO-3 already shards the moments with the params).
+    """
     specs = state_partition_specs(state, mesh, tp=tp, fsdp=fsdp,
                                   min_fsdp_size=min_fsdp_size)
+    if zero1 and not fsdp:
+        opt_specs = state_partition_specs(state, mesh, tp=tp, fsdp=True,
+                                          min_fsdp_size=min_fsdp_size)
+        specs = specs.replace(opt_state=opt_specs.opt_state)
     return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
                                   is_leaf=lambda x: isinstance(x, P))
 

@@ -121,12 +121,12 @@ class Trainer:
         # TP/FSDP state sharding (replicated when neither is requested —
         # reference DDP semantics).
         self.state_sharding = None
-        if step_mesh is not None and (cfg.mesh.fsdp or (
+        if step_mesh is not None and (cfg.mesh.fsdp or cfg.mesh.zero1 or (
                 cfg.mesh.tensor_parallel and self.mesh.shape["model"] > 1)):
             from tpuic.parallel.sharding import shard_state, state_shardings
             self.state_sharding = state_shardings(
                 self.state, self.mesh, tp=cfg.mesh.tensor_parallel,
-                fsdp=cfg.mesh.fsdp)
+                fsdp=cfg.mesh.fsdp, zero1=cfg.mesh.zero1)
             self.state = shard_state(self.state, self.state_sharding)
         self.train_step = make_train_step(cfg.optim, mcfg, step_mesh,
                                           lr_schedule=self.schedule,

@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", action="store_true",
                    help="shard params + optimizer moments over the data axis "
                         "(ZeRO-3 semantics)")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard ONLY the optimizer moments over the data axis "
+                        "(weight-update sharding: params stay replicated, "
+                        "1/N Adam memory; subsumed by --fsdp)")
     p.add_argument("--attention", default="dense",
                    choices=["dense", "flash", "ring", "ulysses"],
                    help="attention implementation for ViT backbones")
@@ -133,7 +137,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       collect_misclassified=args.collect_misclassified,
                       profile_dir=args.profile_dir, seed=args.seed),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
-                        fsdp=args.fsdp),
+                        fsdp=args.fsdp, zero1=args.zero1),
     )
 
 
