@@ -50,6 +50,16 @@ info = distributed.initialize(coordinator_address="localhost:{port}",
 assert info.process_count == 2, info
 assert info.process_index == pid, info
 
+# Cross-host preemption agreement (runtime/preemption.py): one rank's
+# local SIGTERM latch must become a UNANIMOUS verdict — both ranks call
+# agree() at the same boundary and both must see True; with no latch
+# anywhere, both see False.
+from tpuic.runtime.preemption import PreemptionGuard, agree
+_g = PreemptionGuard()
+if pid == 0:
+    _g.trigger()
+_agree = [bool(agree(_g.triggered)), bool(agree(False))]
+
 import numpy as np
 from tpuic.config import DataConfig, MeshConfig, ModelConfig, OptimConfig
 from tpuic.data.folder import ImageFolderDataset
@@ -79,7 +89,8 @@ with mesh:
 step = make_train_step(ocfg, mcfg, mesh, donate=False)
 estep = make_eval_step(ocfg, mcfg, mesh, per_sample=True)
 
-out = {{"pid": pid, "losses": [], "ids": [], "wrong": None}}
+out = {{"pid": pid, "losses": [], "ids": [], "wrong": None,
+        "agree": _agree}}
 for i, batch in enumerate(loader.epoch(0)):
     state, m = step(state, {{k: batch[k] for k in ("image", "label", "mask")}})
     out["losses"].append(float(m["loss"]))
@@ -129,6 +140,9 @@ def test_two_process_distributed_train_and_gather(tree):
                 results[i] = json.loads(line[len("RESULT "):])
     assert set(results) == {0, 1}, logs
     r0, r1 = results[0], results[1]
+    # Preemption agreement: rank 0's latch propagated to rank 1; no-latch
+    # round stayed False on both.
+    assert r0["agree"] == [True, False] and r1["agree"] == [True, False]
     # Global-mean loss: bitwise identical on both ranks (the reference
     # needed an explicit all_reduce for this, train.py:61-63).
     assert r0["losses"] == r1["losses"]

@@ -126,9 +126,10 @@ def state_shardings(state, mesh: Mesh, *, tp: bool = True, fsdp: bool = False,
     specs = state_partition_specs(state, mesh, tp=tp, fsdp=fsdp,
                                   min_fsdp_size=min_fsdp_size)
     if zero1 and not fsdp:
-        opt_specs = state_partition_specs(state, mesh, tp=tp, fsdp=True,
+        opt_specs = state_partition_specs(state.opt_state, mesh, tp=tp,
+                                          fsdp=True,
                                           min_fsdp_size=min_fsdp_size)
-        specs = specs.replace(opt_state=opt_specs.opt_state)
+        specs = specs.replace(opt_state=opt_specs)
     return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
                                   is_leaf=lambda x: isinstance(x, P))
 
