@@ -29,3 +29,24 @@ structural analysis):
 __version__ = "0.1.0"
 
 from tpuic.config import Config  # noqa: F401
+
+# Heavyweight entry points resolve lazily (PEP 562) so `import tpuic`
+# stays cheap (Config is pure dataclasses; Trainer pulls jax/flax).
+_LAZY = {
+    "Trainer": ("tpuic.train.loop", "Trainer"),
+    "create_model": ("tpuic.models", "create_model"),
+    "available_models": ("tpuic.models", "available_models"),
+    "run_predict": ("tpuic.predict", "run_predict"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'tpuic' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
