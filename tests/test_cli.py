@@ -60,3 +60,23 @@ def test_class_weights_bad_token_clean_error():
         ["--datadir", "/d", "--class-weights", "auto", "2"])
     with pytest.raises(SystemExit, match="class-weights"):
         cli.config_from_args(args)
+
+
+def test_extended_flags_map_to_config():
+    args = cli.build_parser().parse_args(
+        ["--datadir", "/d", "--val-batchsize", "8", "--prefetch", "3",
+         "--device-cache-mb", "0", "--log-every-steps", "10",
+         "--label-smoothing", "0.1", "--fused-loss"])
+    cfg = cli.config_from_args(args)
+    assert cfg.data.val_batch_size == 8
+    assert cfg.data.prefetch == 3
+    assert cfg.data.device_cache_mb == 0
+    assert cfg.run.log_every_steps == 10
+    assert cfg.optim.label_smoothing == 0.1
+    assert cfg.optim.fused_loss
+    # defaults unchanged
+    cfg0 = cli.config_from_args(cli.build_parser().parse_args(
+        ["--datadir", "/d"]))
+    assert cfg0.data.device_cache_mb == 4096
+    assert cfg0.run.log_every_steps == 50
+    assert not cfg0.optim.fused_loss

@@ -68,6 +68,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "efficientnet_pytorch state_dict); backbone family "
                         "is auto-detected and weights merge leniently")
     p.add_argument("--workers", type=int, default=6)
+    p.add_argument("--val-batchsize", type=int, default=0,
+                   help="per-device val batch (0 = same as --batchsize; the "
+                        "reference pins 1, train.py:118 — only needed there "
+                        "for its per-sample gather)")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="host-side prefetch depth (batches in flight)")
+    p.add_argument("--device-cache-mb", type=int, default=4096,
+                   help="HBM budget for the device-resident dataset cache "
+                        "(0 disables; see docs/performance.md)")
+    p.add_argument("--log-every-steps", type=int, default=50,
+                   help="metric readback cadence; 1 = reference-style "
+                        "per-step logging (serializes dispatch)")
+    p.add_argument("--label-smoothing", type=float, default=0.0)
+    p.add_argument("--fused-loss", action="store_true",
+                   help="use the Pallas fused weighted-CE kernel "
+                        "(tpuic/kernels/cross_entropy.py)")
     p.add_argument("--no-pack", action="store_true",
                    help="disable the packed uint8 cache + device-side "
                         "augmentation; decode every epoch like the reference")
@@ -120,6 +136,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
     return Config(
         data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
                         batch_size=args.batchsize, num_workers=args.workers,
+                        val_batch_size=args.val_batchsize,
+                        prefetch=args.prefetch,
+                        device_cache_mb=args.device_cache_mb,
                         pack=not args.no_pack, cache_dir=args.cache_dir),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
@@ -130,10 +149,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           auto_class_weights=auto_weights,
                           weight_decay=args.weight_decay,
                           warmup_epochs=args.warmup_epochs,
-                          grad_accum_steps=args.grad_accum_steps),
+                          grad_accum_steps=args.grad_accum_steps,
+                          label_smoothing=args.label_smoothing,
+                          fused_loss=args.fused_loss),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
                       init_from=args.init_from,
+                      log_every_steps=args.log_every_steps,
                       collect_misclassified=args.collect_misclassified,
                       profile_dir=args.profile_dir, seed=args.seed),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
