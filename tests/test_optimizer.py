@@ -74,3 +74,36 @@ def test_grad_accum_mid_cycle_is_noop():
     st = tx.init(params)
     upd, st = tx.update({"w": jnp.full((2, 2), 3.0)}, st, params)
     np.testing.assert_array_equal(np.asarray(upd["w"]), 0.0)
+
+
+def test_freeze_backbone_masks_updates():
+    """freeze_backbone: backbone params bitwise unchanged after a step,
+    head params move."""
+    import jax
+    import numpy as np
+    from tpuic.config import ModelConfig, OptimConfig
+    from tpuic.data.synthetic import synthetic_batch
+    from tpuic.models import create_model
+    from tpuic.train.state import create_train_state
+    from tpuic.train.step import make_train_step
+
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=3,
+                       dtype="float32")
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=(), freeze_backbone=True)
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (4, 24, 24, 3))
+    before = jax.tree.map(np.asarray, jax.device_get(state.params))
+    batch = synthetic_batch(4, 24, 3)
+    step = make_train_step(ocfg, mcfg, None, donate=False)
+    s2, _ = step(state, batch)
+    after = jax.tree.map(np.asarray, jax.device_get(s2.params))
+    for a, b in zip(jax.tree_util.tree_leaves(before["backbone"]),
+                    jax.tree_util.tree_leaves(after["backbone"])):
+        np.testing.assert_array_equal(a, b)
+    head_moved = any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(before["head"]),
+                        jax.tree_util.tree_leaves(after["head"])))
+    assert head_moved

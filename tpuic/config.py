@@ -134,6 +134,10 @@ class OptimConfig:
     # update (effective batch = K * global batch). 1 = off.
     grad_accum_steps: int = 1
     label_smoothing: float = 0.0
+    # Head-only fine-tuning: zero updates for the backbone scope, so only
+    # the MLP head trains (pairs with RunConfig.init_from). Gradient-level
+    # freeze — BN running stats still update in train mode.
+    freeze_backbone: bool = False
     # Use the fused Pallas cross-entropy kernel
     # (tpuic/kernels/cross_entropy.py) in the train step.
     fused_loss: bool = False

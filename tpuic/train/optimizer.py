@@ -9,6 +9,7 @@ LARS covers BASELINE.md config 5 (large-batch ResNet-50).
 
 from __future__ import annotations
 
+import jax
 import optax
 
 from tpuic.config import OptimConfig
@@ -53,6 +54,21 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
         raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
     if cfg.grad_clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if cfg.freeze_backbone:
+        # Head-only fine-tuning (companion to --init-from): backbone
+        # params receive zero updates via set_to_zero; only the MLP head
+        # (and any other non-backbone scope) trains. NOT optax.masked —
+        # masked leaves updates outside the mask UNTOUCHED (raw grads
+        # would flow into apply_updates). Note BN running statistics
+        # still update in train mode — freeze covers gradients, not
+        # stats (torch requires_grad_(False) semantics).
+        def _labels(params):
+            return {k: jax.tree.map(
+                        lambda _, lab=("freeze" if k == "backbone"
+                                       else "train"): lab, v)
+                    for k, v in params.items()}
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()}, _labels)
     if cfg.grad_accum_steps > 1:
         # Gradient accumulation: K micro-steps average their grads before
         # one real update — the K-x-larger effective batch when it doesn't

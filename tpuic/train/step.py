@@ -108,6 +108,14 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
         def loss_fn(params):
+            if optim_cfg.freeze_backbone and "backbone" in params:
+                # stop_gradient lets XLA prune the whole backbone backward
+                # pass (the optimizer-side set_to_zero alone would still
+                # compute it, since the grad_norm metric keeps raw grads
+                # live); grad_norm then reflects the head-only update.
+                params = {**params,
+                          "backbone": jax.lax.stop_gradient(
+                              params["backbone"])}
             out, mutated = forward(params, state.batch_stats, images,
                                    dropout_rng)
             loss = classification_loss(out, labels, class_weights=class_weights,
