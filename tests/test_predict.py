@@ -187,3 +187,43 @@ def test_flat_train_fold_still_rejected(tmp_path):
         os.path.join(root, "train", "oops.png"))
     with pytest.raises(ValueError, match="no images"):
         ImageFolderDataset(root, "train", 24, DataConfig(native=False))
+
+
+def test_predict_model_auto(trained, tmp_path):
+    """--model auto resolves name/num_classes/resize from the config.json
+    sidecar the Trainer writes next to its checkpoint tracks."""
+    root, ckpt, _, val_acc = trained
+    from tpuic.predict import resolve_model_auto
+    saved = resolve_model_auto(ckpt)
+    assert saved == {"name": "resnet18-cifar", "num_classes": 3,
+                     "resize_size": 24}
+    out = str(tmp_path / "auto.csv")
+    rc = predict_main(["--datadir", root, "--fold", "val",
+                       "--ckpt-dir", ckpt, "--out", out])
+    assert rc == 0
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 18
+    acc = 100.0 * np.mean([r["label"] == r["pred"] for r in rows])
+    assert acc == pytest.approx(val_acc, abs=1e-6)
+    # Ambiguity and absence are explicit errors.
+    with pytest.raises(FileNotFoundError):
+        resolve_model_auto(str(tmp_path / "none"))
+
+
+def test_predict_model_auto_ambiguous_raises(trained, tmp_path):
+    import json as _j
+    root, ckpt, _, _ = trained
+    from tpuic.predict import resolve_model_auto
+    extra = os.path.join(str(tmp_path / "multi"), "vit-tiny")
+    os.makedirs(extra)
+    src = os.path.join(ckpt, "resnet18-cifar", "config.json")
+    two = str(tmp_path / "multi")
+    os.makedirs(os.path.join(two, "resnet18-cifar"), exist_ok=True)
+    for name in ("resnet18-cifar", "vit-tiny"):
+        with open(src) as f:
+            cfgd = _j.load(f)
+        with open(os.path.join(two, name, "config.json"), "w") as f:
+            _j.dump(cfgd, f)
+    with pytest.raises(ValueError, match="pass --model"):
+        resolve_model_auto(two)

@@ -48,6 +48,35 @@ def build_predict_fn(model):
     return jax.jit(fwd)
 
 
+def resolve_model_auto(ckpt_dir: str) -> dict:
+    """'--model auto': find the single trained model under ``ckpt_dir``.
+
+    Each Trainer writes ``{ckpt_dir}/{model}/config.json`` (the resolved
+    run config) next to its best/latest tracks; with exactly one such
+    model dir, its name/num_classes/resize come from there. Ambiguity
+    (several models) or absence stays an explicit error rather than a
+    guess.
+    """
+    import glob
+
+    hits = sorted(glob.glob(os.path.join(ckpt_dir, "*", "config.json")))
+    if not hits:
+        raise FileNotFoundError(
+            f"--model auto: no <model>/config.json under {ckpt_dir} "
+            "(older checkpoints predate the sidecar — pass --model "
+            "explicitly)")
+    if len(hits) > 1:
+        names = [os.path.basename(os.path.dirname(h)) for h in hits]
+        raise ValueError(
+            f"--model auto: {len(hits)} trained models under {ckpt_dir} "
+            f"({names}) — pass --model explicitly")
+    with open(hits[0]) as f:
+        saved = json.load(f)
+    return {"name": saved["model"]["name"],
+            "num_classes": int(saved["model"]["num_classes"]),
+            "resize_size": int(saved["data"]["resize_size"])}
+
+
 def run_predict(cfg, *, fold: str, track: str, top_k: int,
                 out_path: Optional[str], limit: int = 0) -> dict:
     """Programmatic entry; returns summary stats (rows written, accuracy)."""
@@ -172,10 +201,14 @@ def main(argv=None) -> int:
         description="Classify an ImageFolder fold with a trained checkpoint")
     p.add_argument("--datadir", required=True)
     p.add_argument("--fold", default="val")
-    p.add_argument("--model", default="inceptionv3")
+    p.add_argument("--model", default="auto",
+                   help="backbone name, or 'auto' to read the single "
+                        "trained model's config.json under --ckpt-dir")
     p.add_argument("--num-classes", type=int, default=0,
                    help="0 = infer from the folder tree")
-    p.add_argument("--resize", type=int, default=299)
+    p.add_argument("--resize", type=int, default=None,
+                   help="image size (default: the checkpoint config's size "
+                        "under --model auto, else the reference's 299)")
     p.add_argument("--batchsize", type=int, default=64)
     p.add_argument("--ckpt-dir", default="dtmodel/cp")
     p.add_argument("--track", default="best", choices=("best", "latest"))
@@ -189,12 +222,28 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from tpuic.config import Config, DataConfig, ModelConfig, RunConfig
+    model, num_classes, resize = args.model, args.num_classes, args.resize
+    if model == "auto":
+        if args.init_from:
+            raise SystemExit("predict: --model auto needs a tpuic "
+                             "--ckpt-dir; with --init-from pass --model "
+                             "explicitly")
+        saved = resolve_model_auto(args.ckpt_dir)
+        model = saved["name"]
+        num_classes = num_classes or saved["num_classes"]
+        if resize is None:  # explicit --resize always wins
+            resize = saved["resize_size"]
+        print(f"[predict] auto-resolved model '{model}' "
+              f"(num_classes={num_classes}, resize={resize}) from "
+              f"{args.ckpt_dir}")
+    if resize is None:
+        resize = 299  # the reference's hard-coded size (train.py:110)
     cfg = Config(
-        data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
+        data=DataConfig(data_dir=args.datadir, resize_size=resize,
                         batch_size=args.batchsize,
                         val_batch_size=args.batchsize,
                         pack=not args.no_pack),
-        model=ModelConfig(name=args.model, num_classes=args.num_classes),
+        model=ModelConfig(name=model, num_classes=num_classes),
         run=RunConfig(ckpt_dir=args.ckpt_dir, init_from=args.init_from),
     )
     summary = run_predict(cfg, fold=args.fold, track=args.track,

@@ -138,6 +138,15 @@ class Trainer:
         self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
+        if is_host0():
+            # Reproducibility sidecar: the resolved config (incl. inferred
+            # num_classes / derived class weights) next to the checkpoint
+            # tracks. tpuic.predict reads it to auto-resolve the model.
+            import json
+            resolved = dataclasses.replace(cfg, model=mcfg)
+            with open(os.path.join(self.ckpt.root, "config.json"), "w") as f:
+                json.dump(dataclasses.asdict(resolved), f, indent=2,
+                          default=str)
         # SIGTERM (pod preemption / scheduler eviction) -> finish the
         # current step, flush a 'latest' checkpoint, return cleanly
         # (runtime/preemption.py). The handler is installed for the span of
