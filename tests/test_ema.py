@@ -1,0 +1,138 @@
+"""EMA of parameters (OptimConfig.ema_decay): update math, eval/checkpoint/
+predict wiring. The reference has no EMA; this is the standard modern
+image-classification recipe (EfficientNet/ViT papers)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                          OptimConfig, RunConfig)
+from tpuic.data.synthetic import make_synthetic_imagefolder, synthetic_batch
+from tpuic.models import create_model
+from tpuic.train.loop import Trainer
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_eval_step, make_train_step
+
+
+def test_ema_update_math():
+    """One step: ema' = d*ema0 + (1-d)*params' exactly (ema0 = init)."""
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=3, dtype="float32")
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=(), ema_decay=0.5)
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (4, 24, 24, 3), ema=True)
+    ema0 = jax.tree.map(np.asarray, jax.device_get(state.ema_params))
+    step = make_train_step(ocfg, mcfg, None, donate=False)
+    s2, _ = step(state, synthetic_batch(4, 24, 3))
+    p1 = jax.tree.map(np.asarray, jax.device_get(s2.params))
+    e1 = jax.tree.map(np.asarray, jax.device_get(s2.ema_params))
+    for a, b, c in zip(jax.tree_util.tree_leaves(ema0),
+                       jax.tree_util.tree_leaves(p1),
+                       jax.tree_util.tree_leaves(e1)):
+        np.testing.assert_allclose(c, 0.5 * a + 0.5 * b, atol=1e-6)
+
+
+def test_ema_eval_uses_ema_weights():
+    """eval_step scores the EMA weights, not the raw ones: zeroing
+    ema_params changes eval loss, zeroing params does not."""
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=3, dtype="float32")
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=(), ema_decay=0.9)
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (4, 24, 24, 3), ema=True)
+    batch = synthetic_batch(4, 24, 3)
+    ev = make_eval_step(ocfg, mcfg, None)
+    base = float(ev(state, batch)["loss_num"])
+    zero_params = state.replace(
+        params=jax.tree.map(np.zeros_like, state.params))
+    assert float(ev(zero_params, batch)["loss_num"]) == pytest.approx(
+        base, rel=1e-6)
+    zero_ema = state.replace(
+        ema_params=jax.tree.map(np.zeros_like, state.ema_params))
+    assert float(ev(zero_ema, batch)["loss_num"]) != pytest.approx(
+        base, rel=1e-3)
+
+
+def test_ema_checkpoint_roundtrip_and_predict(tmp_path):
+    """fit() with EMA on: checkpoint carries ema_params; resume restores
+    them; predict --model auto scores with the EMA weights (accuracy equals
+    the trainer's own val number, which also used EMA)."""
+    import csv
+    from tpuic.predict import main as predict_main, resolve_model_auto
+
+    root = str(tmp_path / "d")
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=8,
+                               size=24)
+    ckpt = str(tmp_path / "ck")
+    cfg = Config(
+        data=DataConfig(data_dir=root, resize_size=24, batch_size=2),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="sgd", learning_rate=0.05,
+                          class_weights=(), milestones=(), ema_decay=0.8),
+        run=RunConfig(epochs=2, ckpt_dir=ckpt, save_period=1, resume=False),
+        mesh=MeshConfig(),
+    )
+    trainer = Trainer(cfg)
+    trainer.fit()
+    trainer.ckpt.wait()
+    val = trainer.val_epoch(99)
+    ema_ref = jax.tree.map(np.asarray,
+                           jax.device_get(trainer.state.ema_params))
+
+    resumed = Trainer(cfg.replace(run=RunConfig(
+        epochs=2, ckpt_dir=ckpt, save_period=1, resume=True)))
+    assert resumed.state.ema_params is not None
+    got = jax.tree.map(np.asarray, jax.device_get(resumed.state.ema_params))
+    for a, b in zip(jax.tree_util.tree_leaves(ema_ref),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    assert resolve_model_auto(ckpt)["ema_decay"] == 0.8
+    out = str(tmp_path / "p.csv")
+    rc = predict_main(["--datadir", root, "--ckpt-dir", ckpt, "--out", out,
+                       "--track", "latest"])
+    assert rc == 0
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    acc = 100.0 * np.mean([r["label"] == r["pred"] for r in rows])
+    assert acc == pytest.approx(val, abs=1e-6)
+
+
+def test_ema_decay_validation():
+    with pytest.raises(ValueError, match="ema_decay"):
+        OptimConfig(ema_decay=1.0)
+    with pytest.raises(ValueError, match="ema_decay"):
+        OptimConfig(ema_decay=-0.1)
+
+
+def test_ema_held_between_accumulation_micro_steps():
+    """grad_accum_steps=K: the EMA advances once per REAL update, not K
+    times (which would compound the decay to d^K)."""
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=3, dtype="float32")
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=(), ema_decay=0.5, grad_accum_steps=2)
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (4, 24, 24, 3), ema=True)
+    ema0 = jax.tree.map(np.asarray, jax.device_get(state.ema_params))
+    step = make_train_step(ocfg, mcfg, None, donate=False)
+    batch = synthetic_batch(4, 24, 3)
+    s1, _ = step(state, batch)      # micro-step 1: no real update
+    e1 = jax.tree.map(np.asarray, jax.device_get(s1.ema_params))
+    for a, b in zip(jax.tree_util.tree_leaves(ema0),
+                    jax.tree_util.tree_leaves(e1)):
+        np.testing.assert_array_equal(a, b)
+    s2, _ = step(s1, batch)         # micro-step 2: real update fires
+    p2 = jax.tree.map(np.asarray, jax.device_get(s2.params))
+    e2 = jax.tree.map(np.asarray, jax.device_get(s2.ema_params))
+    for a, b, c in zip(jax.tree_util.tree_leaves(ema0),
+                       jax.tree_util.tree_leaves(p2),
+                       jax.tree_util.tree_leaves(e2)):
+        np.testing.assert_allclose(c, 0.5 * a + 0.5 * b, atol=1e-6)

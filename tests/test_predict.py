@@ -196,7 +196,7 @@ def test_predict_model_auto(trained, tmp_path):
     from tpuic.predict import resolve_model_auto
     saved = resolve_model_auto(ckpt)
     assert saved == {"name": "resnet18-cifar", "num_classes": 3,
-                     "resize_size": 24}
+                     "resize_size": 24, "ema_decay": 0.0}
     out = str(tmp_path / "auto.csv")
     rc = predict_main(["--datadir", root, "--fold", "val",
                        "--ckpt-dir", ckpt, "--out", out])

@@ -102,7 +102,7 @@ class CheckpointManager:
             to_host = lambda t: jax.tree.map(np.asarray, jax.device_get(t))  # noqa: E731
         else:
             to_host = lambda t: t  # noqa: E731
-        return {
+        payload = {
             "params": to_host(state.params),
             "batch_stats": to_host(state.batch_stats),
             "opt_state": to_host(state.opt_state),
@@ -110,6 +110,9 @@ class CheckpointManager:
                      "best_score": np.float64(best_score),
                      "step": np.asarray(jax.device_get(state.step))},
         }
+        if getattr(state, "ema_params", None) is not None:
+            payload["ema_params"] = to_host(state.ema_params)
+        return payload
 
     def wait(self) -> None:
         """Block until any in-flight async save has committed."""
@@ -235,6 +238,8 @@ class CheckpointManager:
                                   batch_stats=restored["batch_stats"],
                                   opt_state=restored["opt_state"],
                                   step=np.asarray(meta.get("step", 0)))
+            if "ema_params" in restored:
+                state = state.replace(ema_params=restored["ema_params"])
             host0_print(f"[ckpt] restored (sharded) from {path} "
                         f"(epoch {epoch}, best {best:.4f})")
             return state, epoch + 1, best
@@ -256,6 +261,19 @@ class CheckpointManager:
         merged_stats, _, _ = lenient_restore(cur_stats,
                                              restored.get("batch_stats", {}))
         state = state.replace(params=merged_params, batch_stats=merged_stats)
+        if getattr(state, "ema_params", None) is not None:
+            if restored.get("ema_params"):
+                cur_ema = jax.tree.map(np.asarray,
+                                       jax.device_get(state.ema_params))
+                merged_ema, _, _ = lenient_restore(cur_ema,
+                                                   restored["ema_params"])
+                state = state.replace(ema_params=merged_ema)
+            else:
+                # Pre-EMA checkpoint into an EMA run: reseed at the
+                # restored params rather than keeping the random-init copy
+                # (which validation would score for ~1/(1-d) updates).
+                state = state.replace(
+                    ema_params=jax.tree.map(np.copy, merged_params))
         meta = restored.get("meta", {})
         epoch = int(meta.get("epoch", 0))
         best = float(meta.get("best_score", 0.0))

@@ -518,7 +518,14 @@ def init_state_from_torch(state, path: str, model_name: str, log=print):
         tree["batch_stats"])
     log(f"[init] {path}: loaded {n}/{total} param and "
         f"{n_s}/{total_s} batch-stat leaves")
-    return state.replace(params=params, batch_stats=stats)
+    state = state.replace(params=params, batch_stats=stats)
+    if state.ema_params is not None:
+        # Reseed the EMA at the merged (pretrained) weights — leaving it
+        # at the random init would have validation score a near-random
+        # network for ~1/(1-d) updates.
+        state = state.replace(
+            ema_params=jax.tree.map(np.copy, params))
+    return state
 
 
 # ---------------------------------------------------------------------------

@@ -134,6 +134,13 @@ class OptimConfig:
     # update (effective batch = K * global batch). 1 = off.
     grad_accum_steps: int = 1
     label_smoothing: float = 0.0
+    # Exponential moving average of params (0 = off; typical 0.9999).
+    # ema = d*ema + (1-d)*params after each real optimizer update;
+    # validation, checkpoint 'best' selection, and predict then use the
+    # EMA weights — the standard modern image-classification recipe
+    # (EfficientNet/ViT). Must be in [0, 1): 1.0 would freeze the EMA at
+    # its seed forever (validated in __post_init__).
+    ema_decay: float = 0.0
     # Head-only fine-tuning: zero updates for the backbone scope, so only
     # the MLP head trains (pairs with RunConfig.init_from). Gradient-level
     # freeze — BN running stats still update in train mode.
@@ -141,6 +148,12 @@ class OptimConfig:
     # Use the fused Pallas cross-entropy kernel
     # (tpuic/kernels/cross_entropy.py) in the train step.
     fused_loss: bool = False
+
+    def __post_init__(self):
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1); got {self.ema_decay} "
+                "(1.0 would freeze the EMA at its random seed forever)")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -74,7 +74,8 @@ def resolve_model_auto(ckpt_dir: str) -> dict:
         saved = json.load(f)
     return {"name": saved["model"]["name"],
             "num_classes": int(saved["model"]["num_classes"]),
-            "resize_size": int(saved["data"]["resize_size"])}
+            "resize_size": int(saved["data"]["resize_size"]),
+            "ema_decay": float(saved.get("optim", {}).get("ema_decay", 0.0))}
 
 
 def run_predict(cfg, *, fold: str, track: str, top_k: int,
@@ -113,7 +114,8 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
     model = create_model_from_config(mcfg)
     state = create_train_state(
         model, make_optimizer(cfg.optim), jax.random.key(0),
-        (1, d.resize_size, d.resize_size, 3))
+        (1, d.resize_size, d.resize_size, 3),
+        ema=cfg.optim.ema_decay > 0)
 
     if cfg.run.init_from:
         from tpuic.checkpoint.torch_convert import init_state_from_torch
@@ -142,9 +144,12 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
               f"{max(0, next_epoch - 1)}, best {best:.2f})")
 
     # One up-front transfer: the lenient-restore path leaves host numpy
-    # leaves, which a jitted call would re-upload every batch.
+    # leaves, which a jitted call would re-upload every batch. EMA-trained
+    # checkpoints predict with the EMA weights (state.inference_params,
+    # the same choice val_epoch makes).
     variables = jax.device_put(
-        {"params": state.params, "batch_stats": state.batch_stats})
+        {"params": state.inference_params,
+         "batch_stats": state.batch_stats})
     predict = build_predict_fn(model)
     # Class names come from the fold tree; an unlabeled flat fold has none,
     # so predictions fall back to the raw class index as a string.
@@ -221,8 +226,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-pack", action="store_true")
     args = p.parse_args(argv)
 
-    from tpuic.config import Config, DataConfig, ModelConfig, RunConfig
+    from tpuic.config import (Config, DataConfig, ModelConfig, OptimConfig,
+                              RunConfig)
     model, num_classes, resize = args.model, args.num_classes, args.resize
+    ema_decay = 0.0
     if model == "auto":
         if args.init_from:
             raise SystemExit("predict: --model auto needs a tpuic "
@@ -231,11 +238,22 @@ def main(argv=None) -> int:
         saved = resolve_model_auto(args.ckpt_dir)
         model = saved["name"]
         num_classes = num_classes or saved["num_classes"]
+        ema_decay = saved["ema_decay"]  # EMA checkpoints predict with EMA
         if resize is None:  # explicit --resize always wins
             resize = saved["resize_size"]
         print(f"[predict] auto-resolved model '{model}' "
               f"(num_classes={num_classes}, resize={resize}) from "
               f"{args.ckpt_dir}")
+    elif not args.init_from:
+        # Explicit --model: still honor THIS model's config.json sidecar
+        # for ema_decay, so an EMA-trained checkpoint scores its EMA
+        # weights (the ones 'best' was selected on) instead of silently
+        # falling back to the raw params.
+        sidecar = os.path.join(args.ckpt_dir, model, "config.json")
+        if os.path.isfile(sidecar):
+            with open(sidecar) as f:
+                ema_decay = float(
+                    json.load(f).get("optim", {}).get("ema_decay", 0.0))
     if resize is None:
         resize = 299  # the reference's hard-coded size (train.py:110)
     cfg = Config(
@@ -244,6 +262,7 @@ def main(argv=None) -> int:
                         val_batch_size=args.batchsize,
                         pack=not args.no_pack),
         model=ModelConfig(name=model, num_classes=num_classes),
+        optim=OptimConfig(ema_decay=ema_decay),
         run=RunConfig(ckpt_dir=args.ckpt_dir, init_from=args.init_from),
     )
     summary = run_predict(cfg, fold=args.fold, track=args.track,

@@ -117,7 +117,8 @@ class Trainer:
         shape = (global_batch, d.resize_size, d.resize_size, 3)
         with self.mesh:
             self.state = create_train_state(
-                self.model, tx, jax.random.key(cfg.run.seed), shape)
+                self.model, tx, jax.random.key(cfg.run.seed), shape,
+                ema=cfg.optim.ema_decay > 0)
         # TP/FSDP state sharding (replicated when neither is requested —
         # reference DDP semantics).
         self.state_sharding = None

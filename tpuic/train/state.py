@@ -25,6 +25,17 @@ class TrainState(struct.PyTreeNode):
     opt_state: optax.OptState
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # Exponential moving average of params (OptimConfig.ema_decay > 0);
+    # None disables — an empty pytree subtree, so shardings, donation, and
+    # checkpoints are unaffected when off.
+    ema_params: Any = None
+
+    @property
+    def inference_params(self):
+        """The weights evaluation/inference should score: the EMA when the
+        recipe maintains one, else the raw params. The single source of
+        truth for eval_step, predict, and best-checkpoint selection."""
+        return self.ema_params if self.ema_params is not None else self.params
 
     def apply_gradients(self, grads) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -34,11 +45,13 @@ class TrainState(struct.PyTreeNode):
 
 
 def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
-                       input_shape, train: bool = True) -> TrainState:
+                       input_shape, train: bool = True,
+                       ema: bool = False) -> TrainState:
     """Initialize params/batch_stats with a dummy batch of ``input_shape``.
 
     The batch dim is forced to 1: param shapes don't depend on it, and a
     global-batch-sized unsharded dummy would OOM device 0 at pod scale.
+    ``ema=True`` seeds ema_params = params (no debias term needed).
     """
     dummy = jnp.zeros((1,) + tuple(input_shape[1:]), jnp.float32)
     # Init in train mode so branches that only exist then (inception aux head,
@@ -58,4 +71,8 @@ def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
         opt_state=tx.init(params),
         apply_fn=model.apply,
         tx=tx,
+        # A REAL copy: sharing params' buffers would double-donate them
+        # under the jitted step's donate_argnums and wedge the executable.
+        ema_params=(jax.tree.map(lambda x: jnp.array(x, copy=True), params)
+                    if ema else None),
     )

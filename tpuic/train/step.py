@@ -135,6 +135,21 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             loss_fn, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads).replace(
             batch_stats=new_stats)
+        if optim_cfg.ema_decay > 0 and state.ema_params is not None:
+            d = optim_cfg.ema_decay
+            new_ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p,
+                                   state.ema_params, new_state.params)
+            k = max(1, optim_cfg.grad_accum_steps)
+            if k > 1:
+                # Under gradient accumulation params move only every K-th
+                # micro-step (optax.MultiSteps); advancing the EMA on the
+                # other K-1 would compound the decay to d^K per real
+                # update. Hold it between real updates instead.
+                is_update = ((state.step + 1) % k) == 0
+                new_ema = jax.tree.map(
+                    lambda ne, e: jnp.where(is_update, ne, e),
+                    new_ema, state.ema_params)
+            new_state = new_state.replace(ema_params=new_ema)
         acc = accuracy(logits, labels)
         if mask is not None:
             m = mask.astype(jnp.float32)
@@ -189,7 +204,10 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         mask = batch.get("mask")
         m = (mask.astype(jnp.float32) if mask is not None
              else jnp.ones(labels.shape, jnp.float32))
-        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        # Validation (and thus 'best' checkpoint selection) uses the EMA
+        # weights when the recipe maintains them (state.inference_params).
+        variables = {"params": state.inference_params,
+                     "batch_stats": state.batch_stats}
         logits = state.apply_fn(variables, images, train=False)
         acc = accuracy(logits, labels)
         loss = classification_loss(logits, labels, class_weights=class_weights,

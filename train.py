@@ -81,6 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metric readback cadence; 1 = reference-style "
                         "per-step logging (serializes dispatch)")
     p.add_argument("--label-smoothing", type=float, default=0.0)
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="EMA of params (0 off; typical 0.9999); validation "
+                        "and best-checkpoint selection use EMA weights")
     p.add_argument("--freeze-backbone", action="store_true",
                    help="train only the MLP head (pairs with --init-from); "
                         "gradient-level freeze, BN stats still update")
@@ -154,6 +157,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           warmup_epochs=args.warmup_epochs,
                           grad_accum_steps=args.grad_accum_steps,
                           label_smoothing=args.label_smoothing,
+                          ema_decay=args.ema_decay,
                           freeze_backbone=args.freeze_backbone,
                           fused_loss=args.fused_loss),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
