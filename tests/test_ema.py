@@ -136,3 +136,35 @@ def test_ema_held_between_accumulation_micro_steps():
                        jax.tree_util.tree_leaves(p2),
                        jax.tree_util.tree_leaves(e2)):
         np.testing.assert_allclose(c, 0.5 * a + 0.5 * b, atol=1e-6)
+
+
+def test_ema_shards_like_params_under_fsdp():
+    """FSDP + EMA: the ema subtree gets the same sharding specs as params
+    (it mirrors their shapes), and a sharded step preserves them."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpuic.config import MeshConfig
+    from tpuic.parallel.sharding import shard_state, state_shardings
+    from tpuic.runtime.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(), jax.devices())
+    mcfg = ModelConfig(name="resnet18-cifar", num_classes=3, dtype="float32")
+    ocfg = OptimConfig(optimizer="adam", learning_rate=1e-3,
+                       class_weights=(), milestones=(), ema_decay=0.9)
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (8, 24, 24, 3), ema=True)
+    sh = state_shardings(state, mesh, tp=False, fsdp=True)
+    p_specs = [s.spec for s in jax.tree_util.tree_leaves(sh.params)]
+    e_specs = [s.spec for s in jax.tree_util.tree_leaves(sh.ema_params)]
+    assert p_specs == e_specs
+    assert any(sp != P() for sp in e_specs)  # large leaves sharded
+    sstate = shard_state(state, sh)
+    step = make_train_step(ocfg, mcfg, mesh, donate=False,
+                           state_sharding=sh)
+    batch = synthetic_batch(8, 24, 3)
+    bsh = NamedSharding(mesh, P("data"))
+    s2, m = step(sstate, {k: jax.device_put(v, bsh)
+                          for k, v in batch.items()})
+    assert np.isfinite(float(m["loss"]))
+    for l, spec in zip(jax.tree_util.tree_leaves(s2.ema_params), e_specs):
+        assert l.sharding.spec == spec
