@@ -119,6 +119,11 @@ class Trainer:
             self.state = create_train_state(
                 self.model, tx, jax.random.key(cfg.run.seed), shape,
                 ema=cfg.optim.ema_decay > 0)
+        from tpuic.utils import tree_bytes, tree_size
+        host0_print(f"[model] {mcfg.name}: "
+                    f"{tree_size(self.state.params) / 1e6:.1f}M params "
+                    f"({tree_bytes(self.state.params) >> 20} MB), "
+                    f"{num_classes} classes, global batch {global_batch}")
         # TP/FSDP state sharding (replicated when neither is requested —
         # reference DDP semantics).
         self.state_sharding = None
