@@ -1,0 +1,55 @@
+"""ASan/UBSan run of the native data core (SURVEY.md §5: the reference has
+no sanitizers — and no native code; tpuic has both, so the C++ decode and
+fused-prep paths get a memory-safety pass in CI: real JPEG/PNG inputs,
+every truncation prefix, bit-corrupted streams, and garbage buffers, all
+under -fsanitize=address,undefined with recovery disabled."""
+
+import io
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+from PIL import Image
+
+_NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tpuic", "native")
+
+
+def _asan_available() -> bool:
+    if not shutil.which("g++"):
+        return False
+    probe = subprocess.run(
+        ["g++", "-fsanitize=address", "-x", "c++", "-", "-o", os.devnull],
+        input=b"int main(){return 0;}", capture_output=True)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(not _asan_available(), reason="no g++/ASan toolchain")
+def test_native_core_under_asan_ubsan(tmp_path):
+    exe = str(tmp_path / "sanitize_main")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         os.path.join(_NATIVE, "sanitize_main.cpp"),
+         os.path.join(_NATIVE, "decode.cpp"),
+         os.path.join(_NATIVE, "dataprep.cpp"),
+         "-o", exe, "-ljpeg", "-lpng"],
+        capture_output=True, text=True, timeout=240)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (48, 60, 3), np.uint8)
+    png = str(tmp_path / "x.png")
+    Image.fromarray(img).save(png)
+    jpg = str(tmp_path / "x.jpg")
+    Image.fromarray(img).save(jpg, quality=90)
+
+    run = subprocess.run([exe, png, jpg], capture_output=True, text=True,
+                         timeout=240,
+                         env={**os.environ,
+                              "ASAN_OPTIONS": "abort_on_error=1:detect_leaks=1",
+                              "UBSAN_OPTIONS": "halt_on_error=1"})
+    assert run.returncode == 0, (run.stdout + run.stderr)[-3000:]
+    assert "SANITIZE OK" in run.stdout

@@ -156,6 +156,12 @@ int decode_png(const uint8_t* data, int64_t len, std::vector<uint8_t>& pixels,
     png_destroy_read_struct(&png, nullptr, nullptr);
     return 1;
   }
+  // Constructed BEFORE setjmp (same rule as decode_jpeg's `row`):
+  // png_error longjmps out of png_read_image, and a vector whose lifetime
+  // began after setjmp never runs its destructor on that path — every
+  // corrupt PNG then leaks its row-pointer block (found by the ASan
+  // harness, tests/test_native_sanitize.py).
+  std::vector<png_bytep> rows;
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     return 1;
@@ -175,7 +181,7 @@ int decode_png(const uint8_t* data, int64_t len, std::vector<uint8_t>& pixels,
     return 1;
   }
   pixels.resize(static_cast<int64_t>(h) * w * 3);
-  std::vector<png_bytep> rows(h);
+  rows.resize(h);
   for (int i = 0; i < h; ++i) {
     rows[i] = pixels.data() + static_cast<int64_t>(i) * w * 3;
   }

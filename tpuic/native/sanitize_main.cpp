@@ -1,0 +1,91 @@
+// Sanitizer harness for the native data core (SURVEY.md §5 "race detection
+// / sanitizers": absent in the reference; here the C++ decode + prep paths
+// run under AddressSanitizer/UBSan in CI — tests/test_native_sanitize.py
+// compiles this file together with decode.cpp and dataprep.cpp using
+// -fsanitize=address,undefined and runs it against real encoded images,
+// truncated prefixes, and garbage bytes).
+//
+//   sanitize_main <image file> [more files...]
+//
+// For each file: decode+resize to 64x64, run the fused prep pass over
+// every augmentation branch, then re-decode every truncation prefix and a
+// corrupted copy (all must fail cleanly, not crash). Exits 0 and prints
+// "SANITIZE OK" when every path ran without a sanitizer report.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int tpuic_decode_resize(const uint8_t* data, int64_t len, int size,
+                        uint8_t* out);
+void tpuic_prep_image(const uint8_t* src, int h, int w, float* dst, int s,
+                      int rot_k, int vflip, int hflip, int color_op,
+                      float factor, const float* mean, const float* std_);
+}
+
+static std::vector<uint8_t> read_file(const char* path) {
+  std::vector<uint8_t> buf;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return buf;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  buf.resize(n > 0 ? static_cast<size_t>(n) : 0);
+  if (n > 0 && std::fread(buf.data(), 1, buf.size(), f) != buf.size())
+    buf.clear();
+  std::fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <image> [image...]\n", argv[0]);
+    return 2;
+  }
+  const int S = 64;
+  const float mean[3] = {0.485f, 0.456f, 0.406f};
+  const float stdv[3] = {0.229f, 0.224f, 0.225f};
+  std::vector<uint8_t> decoded(S * S * 3);
+  std::vector<float> prepped(S * S * 3);
+
+  for (int a = 1; a < argc; ++a) {
+    std::vector<uint8_t> raw = read_file(argv[a]);
+    if (raw.empty()) {
+      std::fprintf(stderr, "unreadable: %s\n", argv[a]);
+      return 2;
+    }
+    if (tpuic_decode_resize(raw.data(), (int64_t)raw.size(), S,
+                            decoded.data()) != 0) {
+      std::fprintf(stderr, "decode failed: %s\n", argv[a]);
+      return 3;
+    }
+    // Every augmentation branch of the fused prep pass.
+    for (int rot = 0; rot < 4; ++rot)
+      for (int flip = 0; flip < 4; ++flip)
+        for (int color = 0; color < 4; ++color)
+          tpuic_prep_image(decoded.data(), S, S, prepped.data(), S, rot,
+                           flip & 1, flip >> 1, color, 1.07f, mean, stdv);
+    // Truncations: every prefix length must fail or succeed WITHOUT
+    // touching memory out of bounds (rc is irrelevant; surviving is the
+    // assertion).
+    for (size_t cut = 0; cut < raw.size(); cut += 1 + raw.size() / 97)
+      (void)tpuic_decode_resize(raw.data(), (int64_t)cut, S, decoded.data());
+    // Bit corruption in the middle of the stream.
+    std::vector<uint8_t> bad = raw;
+    for (size_t i = bad.size() / 3; i < bad.size() && i < bad.size() / 3 + 64;
+         ++i)
+      bad[i] ^= 0xA5;
+    (void)tpuic_decode_resize(bad.data(), (int64_t)bad.size(), S,
+                              decoded.data());
+  }
+  // Pure garbage of several sizes.
+  for (int n : {0, 1, 3, 16, 4096}) {
+    std::vector<uint8_t> junk(n, 0x5A);
+    (void)tpuic_decode_resize(junk.data(), n, S, decoded.data());
+  }
+  std::printf("SANITIZE OK\n");
+  return 0;
+}
