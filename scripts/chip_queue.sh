@@ -8,20 +8,20 @@ probe() { python -c "
 from tpuic.runtime.axon_guard import tpu_reachable
 import sys; sys.exit(0 if tpu_reachable(150) else 1)"; }
 
-probe || exit 2
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
 # 1. THE round-3 item: Trainer.fit at bench-grade throughput via the
 #    device-resident cache (chunked upload now).
 TPUIC_FIT_EPOCHS=3 python scripts/fit_proof.py 2>&1 | tail -20 || failures=$((failures+1))
 
-probe || exit 2
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
 # 2. s2d stem sweep at the bench batch size.
 python scripts/perf_sweep.py --batches 96,128 --model resnet50-s2d --out perf/sweep_s2d.json 2>&1 | tail -5 || failures=$((failures+1))
 
-probe || exit 2
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
 # 3. Long-sequence dense-vs-flash crossover.
 python scripts/long_seq_bench.py --sizes 224,384,512 --batch 32 2>&1 | tail -8 || failures=$((failures+1))
 
-probe || exit 2
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
 # 4. Fresh bench line (sanity; the driver runs it too at round end).
 python bench.py 2>&1 | tail -2 || failures=$((failures+1))
 echo "chip_queue: $failures item(s) failed"

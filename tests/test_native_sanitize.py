@@ -18,11 +18,18 @@ _NATIVE = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def _asan_available() -> bool:
+    """g++ with ASan AND the libjpeg/libpng dev headers+libs the real
+    build links — probe the full toolchain so missing pieces skip
+    instead of failing the suite."""
     if not shutil.which("g++"):
         return False
     probe = subprocess.run(
-        ["g++", "-fsanitize=address", "-x", "c++", "-", "-o", os.devnull],
-        input=b"int main(){return 0;}", capture_output=True)
+        ["g++", "-fsanitize=address", "-x", "c++", "-", "-o", os.devnull,
+         "-ljpeg", "-lpng"],
+        input=b"#include <cstddef>\n#include <cstdio>\n"
+              b"#include <jpeglib.h>\n#include <png.h>\n"
+              b"int main(){return 0;}",
+        capture_output=True)
     return probe.returncode == 0
 
 

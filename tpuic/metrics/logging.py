@@ -26,23 +26,33 @@ def host0_print(*args, **kwargs) -> None:
 
 
 class MetricLogger:
-    """Append-only JSONL scalar writer, active on host 0 only."""
+    """Scalar writer, active on host 0 only: JSONL (machine-greppable) +
+    TensorBoard events (metrics/tensorboard.py — no TF dependency), both
+    under ``log_dir``."""
 
     def __init__(self, log_dir: Optional[str] = None) -> None:
         self._fh = None
+        self._tb = None
         if log_dir and is_host0():
             os.makedirs(log_dir, exist_ok=True)
             self._fh = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+            from tpuic.metrics.tensorboard import TensorBoardWriter
+            self._tb = TensorBoardWriter(log_dir)
 
     def write(self, step: int, **scalars) -> None:
         if self._fh is None:
             return
-        rec = {"step": step, "time": time.time()}
-        rec.update({k: float(v) for k, v in scalars.items()})
+        vals = {k: float(v) for k, v in scalars.items()}
+        rec = {"step": step, "time": time.time(), **vals}
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
+        if self._tb is not None:
+            self._tb.scalars(step, **vals)
 
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
