@@ -122,7 +122,7 @@ class Trainer:
         from tpuic.utils import tree_bytes, tree_size
         host0_print(f"[model] {mcfg.name}: "
                     f"{tree_size(self.state.params) / 1e6:.1f}M params "
-                    f"({tree_bytes(self.state.params) >> 20} MB), "
+                    f"({tree_bytes(self.state.params) / (1 << 20):.1f} MB), "
                     f"{num_classes} classes, global batch {global_batch}")
         # TP/FSDP state sharding (replicated when neither is requested —
         # reference DDP semantics).
