@@ -168,7 +168,8 @@ def _measure(platform: str) -> dict:
         if isinstance(fp, dict):
             for src, dst in (("loop_images_per_sec_median_steady",
                               "fit_loop_images_per_sec"),
-                             ("loop_vs_bench", "fit_loop_vs_bench")):
+                             ("loop_vs_bench", "fit_loop_vs_bench"),
+                             ("note", "fit_loop_note")):
                 if fp.get(src) is not None:
                     companions[dst] = fp[src]
     except Exception:
