@@ -68,6 +68,23 @@ def test_loader_pads_final_batch_with_mask(imagefolder):
     assert total_valid == 18  # padding is masked out, not double-counted
 
 
+def test_loader_augment_override_serves_clean_train_fold(imagefolder):
+    """augment=False on a train-fold loader yields the eval-path image
+    (no rot90/flip/jitter) — predict --fold train must classify clean
+    inputs (ADVICE r3), while the default stays fold-derived."""
+    ds = ImageFolderDataset(imagefolder, "train", 16)
+    loader = Loader(ds, global_batch=4, mesh=None, shuffle=False,
+                    num_workers=1, augment=False)
+    id_to_idx = {ds.image_id(i): i for i in range(len(ds))}
+    for b in loader.epoch(0):
+        imgs = np.asarray(b["image"])
+        for i, image_id in enumerate(b.image_ids):
+            if b["mask"][i] == 0:
+                continue
+            clean, _, _ = ds.load(id_to_idx[image_id], None)  # rng=None
+            np.testing.assert_array_equal(imgs[i], clean)
+
+
 def test_loader_drop_last(imagefolder):
     ds = ImageFolderDataset(imagefolder, "train", 16)  # 18 samples
     loader = Loader(ds, global_batch=4, mesh=None, num_workers=1,

@@ -214,20 +214,28 @@ def test_resident_loader_under_mesh(tree, tmp_path):
                                       np.asarray(b["label"]))
 
 
-def test_packed_loader_val_no_augment(tree, tmp_path):
+@pytest.mark.parametrize("fold,loader_kw", [
+    ("val", {}),                      # eval fold: clean by default
+    ("train", {"augment": False}),    # predict --fold train (ADVICE r3)
+])
+def test_packed_loader_serves_clean_images(tree, tmp_path, fold, loader_kw):
+    """Whenever augmentation is off (fold-derived or overridden), packed
+    batches equal normalize(raw) exactly — identity device prep."""
     cfg = DataConfig(data_dir=tree, resize_size=32)
     train_ds = ImageFolderDataset(tree, "train", 32, cfg)
-    ds = ImageFolderDataset(tree, "val", 32, cfg,
-                            class_to_idx=train_ds.class_to_idx)
-    packed = pack_dataset(ds, str(tmp_path / "c3"), verbose=False)
-    assert not packed.train
-    for batch in Loader(packed, global_batch=4, shuffle=False).epoch(0):
+    ds = (train_ds if fold == "train" else
+          ImageFolderDataset(tree, "val", 32, cfg,
+                             class_to_idx=train_ds.class_to_idx))
+    packed = pack_dataset(ds, str(tmp_path / f"c3{fold}"), verbose=False)
+    assert packed.train == (fold == "train")
+    id_to_idx = {ds.image_id(j): j for j in range(len(ds))}
+    for batch in Loader(packed, global_batch=4, shuffle=False,
+                        **loader_kw).epoch(0):
         got = np.asarray(batch["image"])
         for i, image_id in enumerate(batch.image_ids):
             if batch["mask"][i] == 0:
                 continue
-            idx = [ds.image_id(j) for j in range(len(ds))].index(image_id)
-            ref = T.normalize(np.asarray(packed.raw(idx)))
+            ref = T.normalize(np.asarray(packed.raw(id_to_idx[image_id])))
             np.testing.assert_allclose(got[i], ref, atol=1e-5)
 
 
@@ -241,8 +249,9 @@ def test_resident_upload_chunked(tree, tmp_path, monkeypatch):
     ds = ImageFolderDataset(tree, "train", 32, cfg)
     packed = pack_dataset(ds, str(tmp_path / "c5"), verbose=False)
     row_bytes = 32 * 32 * 3
-    # 2 rows per chunk -> ceil(12/2)=6 chunks for the 12-image train fold.
-    monkeypatch.setattr(pl, "_UPLOAD_CHUNK_BYTES", 2 * row_bytes)
+    # 5 rows per chunk -> 5+5+2 for the 12-image train fold: covers both
+    # the full-chunk and the tail-chunk write compiles.
+    monkeypatch.setattr(pl, "_UPLOAD_CHUNK_BYTES", 5 * row_bytes)
     loader = Loader(packed, global_batch=4, seed=7)
     assert loader.resident
     np.testing.assert_array_equal(np.asarray(loader._data_dev),

@@ -33,6 +33,7 @@ import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 from typing import Dict, Iterator, List, Optional
 
 import jax
@@ -44,27 +45,44 @@ from tpuic.data.folder import ImageFolderDataset
 # Resident-cache uploads go to the device in bounded slices. One giant
 # device_put of the whole uint8 dataset is a single multi-hundred-MB
 # transfer; on a slow/flaky host->device link (the tunneled dev platform)
-# that is the observed wedge trigger, while chunking costs only one extra
-# on-device copy (the concatenate) during a one-time setup step.
+# that is the observed wedge trigger. Chunks are written into the final
+# buffer in place (donated updates, synchronized per chunk), so the device
+# peak stays at data_bytes + one chunk — see _upload_resident_chunked.
 _UPLOAD_CHUNK_BYTES = int(os.environ.get("TPUIC_UPLOAD_CHUNK_MB", "64")) << 20
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_chunk(buf, chunk, start):
+    return jax.lax.dynamic_update_slice_in_dim(buf, chunk, start, axis=0)
 
 
 def _upload_resident_chunked(arr) -> jax.Array:
     """Single-device upload of a [N, ...] host array in ~chunk-sized slices.
 
     ``arr`` may be a np.memmap (the packed cache) — slices are materialized
-    one chunk at a time, so host RSS stays bounded too."""
+    one chunk at a time, so host RSS stays bounded too. Chunks are written
+    into a preallocated buffer through a donated update, so the peak device
+    footprint is data_bytes + ONE chunk (the r3 concatenate version held
+    every chunk alive while building the copy — a transient 2x peak the
+    resident-cache fit check didn't budget for; ADVICE r3)."""
     import jax.numpy as jnp
 
     row_bytes = max(1, int(arr.nbytes // max(1, len(arr))))
     rows = max(1, _UPLOAD_CHUNK_BYTES // row_bytes)
     if len(arr) <= rows:
         return jax.device_put(np.ascontiguousarray(arr))
-    parts = []
+    out = jnp.zeros(arr.shape, arr.dtype)
     for lo in range(0, len(arr), rows):
-        parts.append(jax.device_put(np.ascontiguousarray(arr[lo:lo + rows])))
-    out = jnp.concatenate(parts, axis=0)
-    out.block_until_ready()  # parts stay alive until the copy completes
+        chunk = jax.device_put(np.ascontiguousarray(arr[lo:lo + rows]))
+        # start is a traced scalar: one compile for full chunks, one for
+        # the tail, regardless of chunk count.
+        out = _write_chunk(out, chunk, np.int32(lo))
+        # Synchronize per chunk: async dispatch would otherwise enqueue
+        # every chunk's device buffer before any write retires, recreating
+        # the 2x peak (and the in-flight pileup is the wedge trigger on
+        # the flaky link). One-time setup cost; correctness of the budget
+        # check depends on this bound.
+        out.block_until_ready()
     return out
 
 
@@ -118,16 +136,29 @@ class Loader:
                  drop_last: bool = False,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 device_cache_bytes: Optional[int] = None) -> None:
+                 device_cache_bytes: Optional[int] = None,
+                 augment: Optional[bool] = None) -> None:
         """``device_cache_bytes`` overrides DataConfig.device_cache_mb for
         THIS loader — the budget is a per-process total, so a caller that
         builds several loaders (Trainer: train + val) must split it
         (see Trainer.__init__) rather than let each loader claim the full
-        amount."""
+        amount.
+
+        ``augment`` overrides the dataset's fold-derived default
+        (``dataset.train``): inference over the train fold must see clean
+        images (predict.py), while the default keeps the reference's
+        train-fold-augments contract (dp/loader.py:39-52)."""
         self.dataset = dataset
         self.global_batch = int(global_batch)
         self.mesh = mesh
         self.shuffle = dataset.train if shuffle is None else shuffle
+        self.augment = dataset.train if augment is None else bool(augment)
+        if self.augment and not dataset.train:
+            # The decode path (folder.py load) draws augments only for a
+            # train fold; honoring augment=True on val would silently
+            # diverge between the packed and decode executors. Disabling
+            # is the supported override (predict); forcing is not.
+            raise ValueError("augment=True is only valid on a train fold")
         self.seed = seed
         self.num_workers = max(1, num_workers)
         self.prefetch = max(1, prefetch)
@@ -199,8 +230,9 @@ class Loader:
         return len(self)
 
     def _load_one(self, position: int, index: int, valid: bool, epoch: int):
-        rng = np.random.default_rng(
+        rng = (np.random.default_rng(
             np.random.SeedSequence([self.seed, epoch, int(index)]))
+            if self.augment else None)  # rng=None -> clean eval load
         img, label, image_id = self.dataset.load(int(index), rng)
         return position, img, label, image_id, valid
 
@@ -242,7 +274,7 @@ class Loader:
             from tpuic.data.device_prep import pack_params
             ds, c = self.dataset, self.dataset.cfg
             s = ds.resize_size
-            augment = self.dataset.train
+            augment = self.augment
             for b in range(n_batches):
                 if stop.is_set():
                     break

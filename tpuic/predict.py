@@ -158,8 +158,11 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
         idx_to_class.setdefault(i, str(i))
     k = max(1, min(top_k, num_classes))
 
+    # augment=False: --fold train must be classified on CLEAN images; the
+    # fold-derived default would rot90/flip/jitter them (ADVICE r3).
     loader = Loader(ds, cfg.data.resolved_val_batch_size(), shuffle=False,
-                    num_workers=d.num_workers, prefetch=d.prefetch)
+                    num_workers=d.num_workers, prefetch=d.prefetch,
+                    augment=False)
     rows, correct, count = [], 0, 0
     for batch in loader.epoch(0):
         probs, order = predict(variables, batch["image"])
