@@ -262,7 +262,11 @@ def _attach_last_tpu(result: dict) -> None:
     """When the TPU path failed (dev tunnel down — it hung for 8+ hours in
     round 3), surface the last committed real-chip measurement
     (perf/sweep.json, scripts/perf_sweep.py) with provenance so the
-    fallback artifact still carries the chip's demonstrated capability."""
+    fallback artifact still carries the chip's demonstrated capability.
+
+    Attached at TOP level, beside value/vs_baseline: a scoreboard reader
+    must never see the CPU fallback number without the TPU context next to
+    it (VERDICT r3 weak #6 / next-round item 8)."""
     try:
         path = os.path.join(_REPO, "perf", "sweep.json")
         with open(path) as f:
@@ -272,7 +276,7 @@ def _attach_last_tpu(result: dict) -> None:
         if not rows:
             return
         best = max(rows, key=lambda r: r["images_per_sec_per_chip"])
-        result.setdefault("detail", {})["last_tpu_measurement"] = {
+        result["last_tpu_measurement"] = {
             "images_per_sec_per_chip": best["images_per_sec_per_chip"],
             "mfu": best.get("mfu"),
             "per_chip_batch": best.get("per_chip_batch"),
