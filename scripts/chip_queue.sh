@@ -1,6 +1,7 @@
 #!/bin/bash
 # Chip work queue for when the axon tunnel recovers. Run items in order,
 # checking reachability between each (the tunnel can re-wedge).
+# Round-4 ordering = VERDICT r3 "Next round" items 1, 2, 3, 4, 6.
 set -x -o pipefail
 failures=0
 cd /root/repo
@@ -9,20 +10,42 @@ from tpuic.runtime.axon_guard import tpu_reachable
 import sys; sys.exit(0 if tpu_reachable(150) else 1)"; }
 
 probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
-# 1. THE round-3 item: Trainer.fit at bench-grade throughput via the
-#    device-resident cache (chunked upload now).
+# 1. THE round-3 carryover: Trainer.fit at bench-grade throughput via the
+#    device-resident cache (chunked + bounded-peak upload now).
 TPUIC_FIT_EPOCHS=3 python scripts/fit_proof.py 2>&1 | tail -20 || failures=$((failures+1))
 
 probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
-# 2. s2d stem sweep at the bench batch size.
+# 2. Compute-bound MFU datapoint: ViT-B/16 bf16 batch sweep (VERDICT r3
+#    item 2 — the 0.70 north star lives or dies on a transformer number).
+python scripts/perf_sweep.py --batches 32,64,128,256 --model vit-b16 \
+  --out perf/vit_sweep.json 2>&1 | tail -6 || failures=$((failures+1))
+
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
+# 3. s2d stem sweep at the bench batch size.
 python scripts/perf_sweep.py --batches 96,128 --model resnet50-s2d --out perf/sweep_s2d.json 2>&1 | tail -5 || failures=$((failures+1))
 
 probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
-# 3. Long-sequence dense-vs-flash crossover.
+# 4. Long-sequence dense-vs-flash crossover (flash must win somewhere or
+#    be demoted — VERDICT r3 item 4): standard sizes, then the long-N
+#    probe (N=2305/4097 with remat) where dense is expected to OOM.
 python scripts/long_seq_bench.py --sizes 224,384,512 --batch 32 2>&1 | tail -8 || failures=$((failures+1))
+python scripts/long_seq_bench.py --sizes 768,1024 --batch 16 --remat \
+  --out perf/long_seq_4k.json 2>&1 | tail -6 || failures=$((failures+1))
 
 probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
-# 4. Fresh bench line (sanity; the driver runs it too at round end).
+# 5. bench path reconciliation: the SPMD (1-device mesh) step vs the
+#    mesh=None step at the bench config (VERDICT r3 item 6).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --spmd \
+  --out perf/sweep_spmd.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
+# 6. BN-stat bytes: bf16 batch-stat accumulation at the bench config
+#    (VERDICT r3 item 7; tolerance pinned in tests/test_models.py).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --bn-bf16-stats \
+  --out perf/sweep_bnbf16.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
+# 7. Fresh bench line (sanity; the driver runs it too at round end).
 python bench.py 2>&1 | tail -2 || failures=$((failures+1))
 echo "chip_queue: $failures item(s) failed"
 exit $failures

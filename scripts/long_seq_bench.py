@@ -29,7 +29,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def measure(size: int, attention: str, batch: int, n_steps: int = 10):
+def measure(size: int, attention: str, batch: int, n_steps: int = 10,
+            remat: bool = False):
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
@@ -44,8 +45,12 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10):
     from tpuic.train.state import create_train_state
     from tpuic.train.step import make_train_step
 
+    # remat: at N >= 2k the NON-attention activations (qkv/mlp intermediates
+    # x depth) alone exceed HBM at useful batch sizes; rematerializing them
+    # keeps the measurement about the attention memory term, which is the
+    # dense-vs-flash difference this bench exists to isolate.
     mcfg = ModelConfig(name="vit-b16", num_classes=1000, dtype="bfloat16",
-                       attention=attention)
+                       attention=attention, remat=remat)
     ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
                        milestones=())
     model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype,
@@ -70,6 +75,7 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10):
         pass
     n_tokens = (size // 16) ** 2 + 1
     return {"size": size, "tokens": n_tokens, "attention": attention,
+            "remat": remat,
             "step_ms": round(1000 * dt, 2), "peak_mem_mb": mem,
             "images_per_sec": round(batch / dt, 1),
             "platform": jax.devices()[0].platform,
@@ -80,13 +86,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", default="224,384,512")
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize encoder activations (needed to "
+                         "reach N>=2k at useful batch sizes)")
+    ap.add_argument("--out", default=os.path.join(_REPO, "perf",
+                                                  "long_seq.json"))
     ap.add_argument("--_child", nargs=2, metavar=("SIZE", "ATTENTION"),
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args._child:
         size, attention = int(args._child[0]), args._child[1]
-        print(json.dumps(measure(size, attention, args.batch)), flush=True)
+        print(json.dumps(measure(size, attention, args.batch,
+                                 remat=args.remat)), flush=True)
         return 0
 
     from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
@@ -100,8 +112,9 @@ def main():
             try:
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
-                     "--batch", str(args.batch), "--_child", str(size),
-                     attention],
+                     "--batch", str(args.batch)]
+                    + (["--remat"] if args.remat else [])
+                    + ["--_child", str(size), attention],
                     capture_output=True, text=True, cwd=_REPO, timeout=900)
             except subprocess.TimeoutExpired:
                 row = {"size": size, "attention": attention,
@@ -123,10 +136,11 @@ def main():
                        "error": f"rc={proc.returncode}: {tail[:300]}"}
             rows.append(row)
             print(json.dumps(row), flush=True)
-    out = {"batch": args.batch, "model": "vit-b16", "rows": rows}
-    with open(os.path.join(_REPO, "perf", "long_seq.json"), "w") as f:
+    out = {"batch": args.batch, "model": "vit-b16", "remat": args.remat,
+           "rows": rows}
+    with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
-    print("wrote perf/long_seq.json")
+    print(f"wrote {args.out}")
     return 0
 
 

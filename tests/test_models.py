@@ -55,6 +55,30 @@ def test_batch_stats_update_in_train_mode():
     assert any(not np.allclose(a, b) for a, b in zip(before, after))
 
 
+def test_bn_bf16_stats_tolerance():
+    """bn_f32_stats=False (the HBM-byte experiment, ModelConfig) must stay
+    numerically close to the f32-stat default: same params, same bf16
+    inputs, logits and updated batch_stats within bf16-roundoff tolerance."""
+    x = np.asarray(jax.random.normal(jax.random.key(7), (8, 32, 32, 3)),
+                   np.float32)
+    outs = {}
+    for f32 in (True, False):
+        model = create_model("resnet18-cifar", 3, dtype="bfloat16",
+                             bn_f32_stats=f32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        logits, mutated = model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"])
+        outs[f32] = (np.asarray(logits, np.float32),
+                     [np.asarray(l, np.float32) for l in
+                      jax.tree_util.tree_leaves(mutated["batch_stats"])])
+    # init is f32_stats-independent, so the comparison isolates the stat
+    # accumulation dtype. bf16 has ~3 decimal digits; depth compounds it.
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=0.1, atol=0.1)
+    for a, b in zip(outs[True][1], outs[False][1]):
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)
+
+
 def test_unknown_model_raises():
     with pytest.raises(ValueError, match="unknown model"):
         create_model("not-a-model", 2)

@@ -40,7 +40,7 @@ ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
 def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     param_dtype=jnp.float32, bn_momentum: float = 0.9,
                     bn_eps: float = 1e-5, attention: str = "dense",
-                    mesh=None):
+                    mesh=None, bn_f32_stats: bool = True):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     if attention not in ATTENTION_IMPLS:
@@ -49,18 +49,21 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
     factory, has_aux = _REGISTRY[name]
     return factory(num_classes=num_classes, dtype=dtype,
                    param_dtype=param_dtype, bn_momentum=bn_momentum,
-                   bn_eps=bn_eps, attention=attention, mesh=mesh), has_aux
+                   bn_eps=bn_eps, attention=attention, mesh=mesh,
+                   bn_f32_stats=bn_f32_stats), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  dtype="bfloat16", param_dtype="float32",
                  bn_momentum: float = 0.9, bn_eps: float = 1e-5,
-                 attention: str = "dense", mesh=None) -> Classifier:
+                 attention: str = "dense", mesh=None,
+                 bn_f32_stats: bool = True) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
                                         bn_momentum=bn_momentum, bn_eps=bn_eps,
-                                        attention=attention, mesh=mesh)
+                                        attention=attention, mesh=mesh,
+                                        bn_f32_stats=bn_f32_stats)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
@@ -70,16 +73,18 @@ def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
     return create_model(cfg.name, cfg.num_classes, head_widths=cfg.head_widths,
                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                         bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
-                        attention=cfg.attention, mesh=mesh)
+                        attention=cfg.attention, mesh=mesh,
+                        bn_f32_stats=cfg.bn_f32_stats)
 
 
 def _register_builtins():
     def _rn(factory, **extra):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh):
+                 attention, mesh, bn_f32_stats):
             del num_classes, attention, mesh
             return factory(dtype=dtype, param_dtype=param_dtype,
-                           bn_momentum=bn_momentum, bn_eps=bn_eps, **extra)
+                           bn_momentum=bn_momentum, bn_eps=bn_eps,
+                           bn_f32_stats=bn_f32_stats, **extra)
         return make
 
     register("resnet18", _rn(_resnet.resnet18))
@@ -95,8 +100,10 @@ def _register_builtins():
 
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh):
-            del num_classes, bn_eps, attention, mesh  # torch effnet: eps 1e-3
+                 attention, mesh, bn_f32_stats):
+            # torch effnet: eps 1e-3; f32 stats kept (experiment is
+            # ResNet-scoped, ModelConfig.bn_f32_stats).
+            del num_classes, bn_eps, attention, mesh, bn_f32_stats
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -107,8 +114,8 @@ def _register_builtins():
 
     def _vit_factory(ctor):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh):
-            del num_classes, bn_momentum, bn_eps  # no BN in ViT
+                 attention, mesh, bn_f32_stats):
+            del num_classes, bn_momentum, bn_eps, bn_f32_stats  # no BN in ViT
             return ctor(dtype=dtype, param_dtype=param_dtype,
                         attention=attention, mesh=mesh)
         return make
@@ -123,8 +130,9 @@ def _register_builtins():
     register("vit-tiny-moe", _vit_factory(_vit.vit_tiny_moe))
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-             attention, mesh):
-        del bn_eps, attention, mesh  # torch inception: eps 1e-3 (module default)
+             attention, mesh, bn_f32_stats):
+        # torch inception: eps 1e-3 (module default); f32 stats kept.
+        del bn_eps, attention, mesh, bn_f32_stats
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

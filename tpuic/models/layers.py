@@ -43,14 +43,21 @@ class MLPHead(nn.Module):
 
 def batch_norm(train: bool, *, momentum: float = 0.9, eps: float = 1e-5,
                dtype: Any = jnp.float32, param_dtype: Any = jnp.float32,
+               f32_stats: bool = True,
                name: str | None = None) -> nn.BatchNorm:
     """BatchNorm with torch-default hyperparameters (see module docstring).
 
     Under the sharded-jit train step this computes *global* batch statistics —
     the reference's SyncBatchNorm (train.py:124) semantics.
+
+    ``f32_stats=False`` accumulates batch mean/var in the compute dtype
+    (bf16) instead of float32 — a bandwidth experiment: the BN stat
+    fusions are the top HBM readers in the ResNet-50 step profile
+    (ModelConfig.bn_f32_stats).
     """
     return nn.BatchNorm(use_running_average=not train, momentum=momentum,
                         epsilon=eps, dtype=dtype, param_dtype=param_dtype,
+                        force_float32_reductions=f32_stats,
                         name=name)
 
 

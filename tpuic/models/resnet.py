@@ -38,12 +38,14 @@ class BasicBlock(nn.Module):
     bn_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    bn_f32_stats: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
         bn = partial(batch_norm, train, momentum=self.bn_momentum,
                      eps=self.bn_eps, dtype=self.dtype,
-                     param_dtype=self.param_dtype)
+                     param_dtype=self.param_dtype,
+                     f32_stats=self.bn_f32_stats)
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
         residual = x
         y = conv3x3(self.features, self.strides, **kw, name="conv1")(x)
@@ -65,12 +67,14 @@ class Bottleneck(nn.Module):
     bn_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    bn_f32_stats: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
         bn = partial(batch_norm, train, momentum=self.bn_momentum,
                      eps=self.bn_eps, dtype=self.dtype,
-                     param_dtype=self.param_dtype)
+                     param_dtype=self.param_dtype,
+                     f32_stats=self.bn_f32_stats)
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
         out_features = self.features * 4
         residual = x
@@ -100,6 +104,7 @@ class ResNet(nn.Module):
     bn_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    bn_f32_stats: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -126,7 +131,7 @@ class ResNet(nn.Module):
             x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2), padding=3,
                         use_bias=False, **kw, name="conv1")(x)
         x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
-                       **kw, name="bn1")(x)
+                       f32_stats=self.bn_f32_stats, **kw, name="bn1")(x)
         x = nn.relu(x)
         if not self.small_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -135,7 +140,7 @@ class ResNet(nn.Module):
                 strides = 2 if stage > 0 and i == 0 else 1
                 x = self.block(self.num_filters * 2 ** stage, strides,
                                self.bn_momentum, self.bn_eps, self.dtype,
-                               self.param_dtype,
+                               self.param_dtype, self.bn_f32_stats,
                                name=f"layer{stage + 1}_{i}")(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         return x.astype(jnp.float32)

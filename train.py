@@ -121,6 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the forward in backward (trade FLOPs "
                         "for activation memory/bandwidth)")
+    p.add_argument("--bn-bf16-stats", action="store_true",
+                   help="accumulate BatchNorm batch statistics in bf16 "
+                        "instead of f32 (ResNet family; HBM-bandwidth "
+                        "experiment — see ModelConfig.bn_f32_stats)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the first epoch here")
     p.add_argument("--log-dir", default="", help="metrics.jsonl directory")
@@ -148,7 +152,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         pack=not args.no_pack, cache_dir=args.cache_dir),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
-                          remat=args.remat),
+                          remat=args.remat,
+                          bn_f32_stats=not args.bn_bf16_stats),
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
                           class_weights=weights,
