@@ -25,6 +25,12 @@ probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit
 python scripts/perf_sweep.py --batches 96,128 --model resnet50-s2d --out perf/sweep_s2d.json 2>&1 | tail -5 || failures=$((failures+1))
 
 probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
+# 3b. Kernel microbench rerun: flash now uses length-adaptive blocks
+#     (one k-pass at N=197, 512-blocks at N=2048) — refresh the smoke
+#     numbers the r3 "flash never wins" verdict was based on.
+python scripts/pallas_smoke.py 2>&1 | tail -4 || failures=$((failures+1))
+
+probe || { echo "chip_queue: tunnel down ($failures item failures so far)"; exit $((90 + failures)); }
 # 4. Long-sequence dense-vs-flash crossover (flash must win somewhere or
 #    be demoted — VERDICT r3 item 4): standard sizes, then the long-N
 #    probe (N=2305/4097 with remat) where dense is expected to OOM.

@@ -17,15 +17,24 @@ def _rand(key, shape):
     return jax.random.normal(jax.random.key(key), shape, jnp.float32)
 
 
+def _dense_attention(q, k, v):
+    """Reference attention the flash kernel must match."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _dense_loss(q, k, v):
+    return jnp.sum(_dense_attention(q, k, v) ** 2)
+
+
 class TestFlashAttention:
     @pytest.mark.parametrize("n", [8, 17, 64])  # 17: padding path
     def test_matches_dense(self, n):
         b, h, d = 2, 4, 16
         q, k, v = (_rand(i, (b, n, h, d)) for i in range(3))
         got = flash_attention(q, k, v, block_q=8, block_k=8)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-        p = jax.nn.softmax(s, axis=-1)
-        want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        want = _dense_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
@@ -36,13 +45,8 @@ class TestFlashAttention:
         def loss_flash(q, k, v):
             return jnp.sum(flash_attention(q, k, v, block_q=8, block_k=8) ** 2)
 
-        def loss_dense(q, k, v):
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
-
         g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(_dense_loss, argnums=(0, 1, 2))(q, k, v)
         for a, b_ in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
@@ -60,13 +64,28 @@ class TestFlashAttention:
             return jnp.sum(
                 flash_attention(q, k, v, 8, 8, None, mesh) ** 2)
 
-        def loss_dense(q, k, v):
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
-
         g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(_dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("n", [40, 150])  # padded 128 / 256, one k pass
+    def test_auto_blocks_match_dense(self, n):
+        """Default (None) block sizes resolve by sequence length
+        (_resolve_blocks) and must stay exact through forward AND backward —
+        the lse padding depends on the resolved blocks, so fwd/bwd must
+        agree on them."""
+        b, h, d = 1, 2, 8
+        q, k, v = (_rand(i + 30, (b, n, h, d)) for i in range(3))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)  # auto blocks
+
+        np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                   float(_dense_loss(q, k, v)), rtol=1e-4)
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(_dense_loss, argnums=(0, 1, 2))(q, k, v)
         for a, b_ in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)

@@ -156,6 +156,31 @@ def _padded_len(n: int, block_q: int, block_k: int) -> int:
     return max(-(-n // block_q) * block_q, -(-n // block_k) * block_k)
 
 
+def _resolve_blocks(n: int, block_q, block_k):
+    """Fill ``None`` block sizes from the sequence length.
+
+    Heuristic: among square block sizes {128, 256, 384, 512}, take the
+    LARGEST whose padded length stays within 10% of the best achievable —
+    padding is pure waste (masked FLOPs + HBM on every padded key), but
+    per-program grid overhead is why the old fixed 128x128 default was
+    ~2x slower than dense at N=2048 (16x16 inner programs per batch*head,
+    perf/pallas_smoke.json) — so small padding buys big blocks, large
+    padding never does. Examples: 197 -> 256 (one k pass), 577 -> 128
+    (padded 640; larger blocks pad >= 768), 1025 -> 384 (1152),
+    2048 -> 512, 2305 -> 512 (2560, 5% over the 128-block 2432 but 16x
+    fewer programs). VMEM at 512x512 blocks: ~1 MB f32 score tile, 128 KB
+    per f32 operand tile (512x64), two (512,128) f32 m/l scratches at
+    256 KB each — comfortably inside v5e VMEM.
+    """
+    if block_q is None or block_k is None:
+        sizes = (128, 256, 384, 512)
+        best = min(-(-n // b) * b for b in sizes)
+        auto = max(b for b in sizes if -(-n // b) * b <= 1.1 * best)
+        block_q = auto if block_q is None else block_q
+        block_k = auto if block_k is None else block_k
+    return block_q, block_k
+
+
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
                                              "interpret", "with_lse"))
 def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
@@ -385,13 +410,16 @@ def _shard_batch(mesh: Optional[Mesh], b: int) -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+def flash_attention(q, k, v, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     mesh: Optional[Mesh] = None):
     """Softmax attention, [B, N, H, D] in/out, no causal mask (ViT is
-    bidirectional). ``interpret=None`` auto-selects interpret mode off-TPU;
-    ``mesh`` keeps the kernel batch-parallel under a sharded jit (see module
-    docstring)."""
+    bidirectional). ``block_q``/``block_k`` default to a length-adaptive
+    size (``_resolve_blocks``); ``interpret=None`` auto-selects interpret
+    mode off-TPU; ``mesh`` keeps the kernel batch-parallel under a sharded
+    jit (see module docstring)."""
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     return _batch_parallel(
         lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp),
         mesh, interpret, 1, q, k, v)
@@ -418,6 +446,7 @@ def _batch_parallel(fn, mesh, interpret, n_out, *operands):
 
 
 def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh):
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     out, lse = _batch_parallel(
         lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
                                         with_lse=True),
@@ -427,6 +456,8 @@ def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh):
 
 def _vjp_bwd(block_q, block_k, interpret, mesh, res, g):
     q, k, v, out, lse = res
+    # Same resolution as the forward: lse was padded with these blocks.
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     return _batch_parallel(
         lambda interp, *ops: _flash_bwd(*ops, block_q, block_k, interp),
         mesh, interpret, 3, q, k, v, out, lse, g)

@@ -70,7 +70,9 @@ class MultiHeadAttention(nn.Module):
         q, k, v = heads(q), heads(k), heads(v)
         if self.attention == "flash":
             from tpuic.kernels import flash_attention
-            out = flash_attention(q, k, v, 128, 128, None, self.mesh)
+            # None block sizes -> length-adaptive (one k-pass at ViT's
+            # N=197; 512-blocks at long N to amortize grid overhead).
+            out = flash_attention(q, k, v, None, None, None, self.mesh)
         elif (self.attention == "ring" and self.mesh is not None
               and self.mesh.shape.get("seq", 1) > 1):
             from tpuic.parallel import ring_attention
