@@ -115,7 +115,8 @@ def main():
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--attention", default="dense",
-                    help="vit attention impl: dense|flash|ring|ulysses")
+                    help="vit attention impl: "
+                         "dense|flash|ring|ring-flash|ulysses")
     ap.add_argument("--fused-loss", action="store_true",
                     help="Pallas fused cross-entropy")
     ap.add_argument("--spmd", action="store_true",

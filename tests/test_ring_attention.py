@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from tpuic.config import MeshConfig
-from tpuic.parallel import ring_attention
+from tpuic.parallel import ring_attention, ring_flash_attention
 from tpuic.runtime.mesh import make_mesh
 
 
@@ -76,6 +76,55 @@ class TestRingAttention:
                       v.astype(jnp.float32))
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(want), rtol=0.05, atol=0.05)
+
+
+class TestRingFlashAttention:
+    """Ring SP with the Pallas flash kernel as the per-step block primitive
+    (interpret mode on the CPU mesh; the same composition compiles via
+    Mosaic on TPU)."""
+
+    # 16: exact split over ring=4. 10: padded tail block (partially valid).
+    # 5: the 4th ring block is ENTIRELY padding — exercises the kernels'
+    # masked_sentinel (-inf lse) so the block weighs zero in the
+    # cross-block logsumexp combination.
+    @pytest.mark.parametrize("n", [16, 10, 5])
+    def test_matches_dense_fwd_and_bwd(self, devices8, n):
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        b, h, d = 2, 2, 8
+        q, k, v = (_rand(i + 40, (b, n, h, d)) for i in range(3))
+        got = ring_flash_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dense(q, k, v)),
+                                   rtol=1e-4, atol=1e-4)
+        g1 = jax.grad(lambda *a: jnp.sum(ring_flash_attention(*a, mesh) ** 2),
+                      (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(_dense(*a) ** 2), (0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_missing_seq_axis_raises(self, devices8):
+        mesh = jax.sharding.Mesh(np.asarray(devices8).reshape(8, 1),
+                                 ("data", "model"))
+        q = jnp.zeros((2, 16, 2, 8))
+        with pytest.raises(ValueError, match="no 'seq' axis"):
+            ring_flash_attention(q, q, q, mesh)
+
+    def test_ring_flash_vit_matches_dense_vit(self, devices8):
+        from tpuic.models import create_model
+
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        dense = create_model("vit-tiny", 7, dtype="float32",
+                             attention="dense")
+        rf = create_model("vit-tiny", 7, dtype="float32",
+                          attention="ring-flash", mesh=mesh)
+        x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+        variables = dense.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)),
+                               train=False)
+        a = dense.apply(variables, x, train=False)
+        b = rf.apply(variables, x, train=False)  # same params
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
 
 class TestRingViT:

@@ -109,8 +109,9 @@ class ModelConfig:
     # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,
     # tpuic/kernels/flash_attention.py), 'ring' (sequence-parallel ring
     # attention over the mesh 'seq' axis, tpuic/parallel/ring_attention.py),
-    # or 'ulysses' (sequence-parallel all-to-all head redistribution,
-    # tpuic/parallel/ulysses.py). CNNs ignore this.
+    # 'ring-flash' (the ring with the flash kernel as its per-step block
+    # primitive — long-context), or 'ulysses' (sequence-parallel all-to-all
+    # head redistribution, tpuic/parallel/ulysses.py). CNNs ignore this.
     attention: str = "dense"
 
 

@@ -75,14 +75,23 @@ def _f32_for(ref_dtype, x):
     return x.astype(ref_dtype) if ref_dtype != jnp.float32 else x
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                block_k: int, scale: float, valid_len: int,
-                n_k_blocks: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, m_s, l_s,
+                acc_s, *, block_k: int, scale: float, valid_len: int,
+                n_k_blocks: int, masked_sentinel: float):
     """One (batch*head, q-block, k-block) program.
 
     The grid's innermost axis walks key blocks sequentially; (m, l, acc)
     live in VMEM scratch across those steps, so per-program VMEM is
     O(block_q·D + block_k·D) no matter how long the sequence is.
+
+    ``valid_ref`` (SMEM scalar, optional) overrides the static
+    ``valid_len`` — the ring-attention composition rotates key blocks, so
+    the number of real keys in THIS call is only known at trace time.
+    ``masked_sentinel`` is the lse written for fully-masked query rows:
+    0.0 for the single-call path (padded q rows; keeps the backward's
+    exp(s - lse) finite under zero cotangents) and -1e30 for the ring
+    path, where a fully-padded key block's lse must weigh ZERO in the
+    cross-block logsumexp combination.
     """
     ki = pl.program_id(2)
 
@@ -102,7 +111,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     bq = s.shape[0]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
-    s = jnp.where(kpos < valid_len, s, _NEG_INF)
+    vl = valid_len if valid_ref is None else valid_ref[0]
+    s = jnp.where(kpos < vl, s, _NEG_INF)
 
     m = m_s[:, :1]                                       # [bq, 1]
     l = l_s[:, :1]
@@ -123,16 +133,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         o_ref[0] = (acc_s[...] / jnp.maximum(lf, 1e-30)).astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp per query row, the only softmax residual the backward
-            # needs. Fully-masked (padded-q) rows get a finite sentinel.
-            # lse blocks are [1, 1, block_q]: row vectors must keep a
-            # unit second-minor dim — Mosaic requires the last two block
-            # dims to be (mult of 8, mult of 128) OR equal to the array
-            # dims, which a [1, block_q] block of a 2D array violates
+            # needs. Fully-masked rows get ``masked_sentinel`` (see
+            # docstring). lse blocks are [1, 1, block_q]: row vectors must
+            # keep a unit second-minor dim — Mosaic requires the last two
+            # block dims to be (mult of 8, mult of 128) OR equal to the
+            # array dims, which a [1, block_q] block of a 2D array violates
             # (surfaced on real TPU, round-3 smoke; interpret mode did
             # not enforce it).
             lse_ref[0, 0] = jnp.where(
                 mf[:, 0] > _NEG_INF / 2,
-                mf[:, 0] + jnp.log(jnp.maximum(lf[:, 0], 1e-30)), 0.0)
+                mf[:, 0] + jnp.log(jnp.maximum(lf[:, 0], 1e-30)),
+                masked_sentinel)
 
 
 def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
@@ -182,11 +193,16 @@ def _resolve_blocks(n: int, block_q, block_k):
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
-                                             "interpret", "with_lse"))
+                                             "interpret", "with_lse",
+                                             "masked_sentinel"))
 def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
-               with_lse: bool = False):
+               with_lse: bool = False, valid=None,
+               masked_sentinel: float = 0.0):
     """q,k,v: [B, N, H, D] -> out [B, N, H, D] (and logsumexp [B*H, N_padded]
-    when with_lse — the backward residual). Single-device (or per-shard)."""
+    when with_lse — the backward residual). Single-device (or per-shard).
+
+    ``valid``: optional [1] int32 device scalar overriding the static key
+    validity count (the ring composition's rotating block ownership)."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_padded = _padded_len(n, block_q, block_k)
@@ -196,6 +212,18 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
     vf = _fold(v, b, h, n, d, n_padded)
     n_k_blocks = n_padded // block_k
     grid = (b * h, n_padded // block_q, n_k_blocks)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, kf, vf]
+    if valid is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(valid.astype(jnp.int32))
     out_shape = [jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype)]
     # The o/lse blocks revisit the same tile across the (sequential)
     # innermost k axis; writes land on the final k step.
@@ -210,25 +238,21 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
                                       lambda i, j, ki: (i, 0, j),
                                       memory_space=pltpu.VMEM))
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
-        lse_ref = rest[0] if with_lse else None
-        scratch = rest[1:] if with_lse else rest
-        _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        valid_ref, rest = ((rest[0], rest[1:]) if valid is not None
+                           else (None, rest))
+        o_ref = rest[0]
+        lse_ref = rest[1] if with_lse else None
+        scratch = rest[2:] if with_lse else rest[1:]
+        _fwd_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, *scratch,
                     block_k=block_k, scale=scale, valid_len=n,
-                    n_k_blocks=n_k_blocks)
+                    n_k_blocks=n_k_blocks, masked_sentinel=masked_sentinel)
 
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[pltpu.VMEM((block_q, _LANES), jnp.float32),
                         pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -239,16 +263,16 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
             flops=4 * b * h * n_padded * n_padded * d,
             bytes_accessed=3 * b * h * n_padded * d * q.dtype.itemsize,
             transcendentals=b * h * n_padded * n_padded),
-    )(qf, kf, vf)
+    )(*operands)
     out = _unfold(res[0], b, h, n, d)
     if with_lse:
         return out, res[1]
     return out
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_s, *, block_k: int, scale: float, valid_len: int,
-                   n_k_blocks: int):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, valid_ref,
+                   dq_ref, acc_s, *, block_k: int, scale: float,
+                   valid_len: int, n_k_blocks: int):
     """One (bh, q-block, k-block) program: dq = scale * Σ_j ds_j @ k_j,
     accumulated in VMEM scratch across the sequential k axis."""
     ki = pl.program_id(2)
@@ -268,7 +292,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     bq = s.shape[0]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
-    s = jnp.where(kpos < valid_len, s, _NEG_INF)
+    vl = valid_len if valid_ref is None else valid_ref[0]
+    s = jnp.where(kpos < vl, s, _NEG_INF)
     p = jnp.exp(s - lse)                                 # [bq, bk]
     dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32,
@@ -284,8 +309,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_s, dv_s, *, block_q: int, scale: float,
-                    valid_len: int, n_q_blocks: int):
+                    valid_ref, dk_ref, dv_ref, dk_s, dv_s, *, block_q: int,
+                    scale: float, valid_len: int, n_q_blocks: int):
     """One (bh, k-block, q-block) program: dk/dv accumulated in VMEM scratch
     across the sequential q axis."""
     qi_idx = pl.program_id(2)
@@ -307,7 +332,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                     (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=prec)
-    s = jnp.where(kpos < valid_len, s, _NEG_INF)         # [bq, bk]
+    vl = valid_len if valid_ref is None else valid_ref[0]
+    s = jnp.where(kpos < vl, s, _NEG_INF)                # [bq, bk]
     p = jnp.exp(s - lse)
     dv_s[...] += jax.lax.dot_general(_f32_for(dt, p), do_ref[0],
                                      (((0,), (0,)), ((), ())),
@@ -331,9 +357,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
                                              "interpret"))
 def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
-               interpret: bool):
+               interpret: bool, valid=None):
     """Blockwise backward: (dq, dk, dv), each [B, N, H, D]. lse is the folded
-    [B*H, 1, N_padded] logsumexp saved by the forward."""
+    [B*H, 1, N_padded] logsumexp saved by the forward. ``valid`` as in
+    :func:`_flash_fwd`."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_padded = _padded_len(n, block_q, block_k)
@@ -360,14 +387,27 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
     row_red = lambda bsz: pl.BlockSpec((1, 1, bsz),
                                        lambda i, j, r: (i, 0, r),
                                        memory_space=pltpu.VMEM)
+    operands = [qf, kf, vf, dof, lse, delta]
+    extra_specs = []
+    if valid is not None:
+        operands.append(valid.astype(jnp.int32))
+        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+
+    def _dq_kernel(*refs):
+        if valid is not None:
+            *ins, valid_ref, dq_ref, acc_s = refs
+        else:
+            *ins, dq_ref, acc_s = refs
+            valid_ref = None
+        _bwd_dq_kernel(*ins, valid_ref, dq_ref, acc_s, block_k=block_k,
+                       scale=scale, valid_len=n, n_k_blocks=n_k_blocks)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
-                          valid_len=n, n_k_blocks=n_k_blocks),
+        _dq_kernel,
         out_shape=jax.ShapeDtypeStruct((b * h, n_padded, d), q.dtype),
         grid=(b * h, n_q_blocks, n_k_blocks),
         in_specs=[own(block_q), red(block_k), red(block_k), own(block_q),
-                  row_own(block_q), row_own(block_q)],
+                  row_own(block_q), row_own(block_q)] + extra_specs,
         out_specs=own(block_q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(),
@@ -376,16 +416,25 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
             flops=5 * b * h * n_padded * n_padded * d,
             bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
             transcendentals=b * h * n_padded * n_padded),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*operands)
+
+    def _dkv_kernel(*refs):
+        if valid is not None:
+            *ins, valid_ref, dk_ref, dv_ref, dk_s, dv_s = refs
+        else:
+            *ins, dk_ref, dv_ref, dk_s, dv_s = refs
+            valid_ref = None
+        _bwd_dkv_kernel(*ins, valid_ref, dk_ref, dv_ref, dk_s, dv_s,
+                        block_q=block_q, scale=scale, valid_len=n,
+                        n_q_blocks=n_q_blocks)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
-                          valid_len=n, n_q_blocks=n_q_blocks),
+        _dkv_kernel,
         out_shape=[jax.ShapeDtypeStruct((b * h, n_padded, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, n_padded, d), v.dtype)],
         grid=(b * h, n_k_blocks, n_q_blocks),
         in_specs=[red(block_q), own(block_k), own(block_k), red(block_q),
-                  row_red(block_q), row_red(block_q)],
+                  row_red(block_q), row_red(block_q)] + extra_specs,
         out_specs=[own(block_k), own(block_k)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -395,7 +444,7 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
             flops=5 * b * h * n_padded * n_padded * d,
             bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
             transcendentals=b * h * n_padded * n_padded),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*operands)
 
     return (_unfold(dq, b, h, n, d), _unfold(dk, b, h, n, d),
             _unfold(dv, b, h, n, d))

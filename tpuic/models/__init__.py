@@ -34,7 +34,7 @@ def available_models():
     return sorted(_REGISTRY)
 
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses")
 
 
 def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,

@@ -32,7 +32,7 @@ def _dense(features, name, dtype, param_dtype, logical):
     )
 
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses")
 
 
 class MultiHeadAttention(nn.Module):
@@ -44,9 +44,11 @@ class MultiHeadAttention(nn.Module):
     # probability matrix (tpuic/kernels/flash_attention.py).
     # 'ring': sequence-parallel ring attention over the mesh's 'seq' axis
     # (tpuic/parallel/ring_attention.py) — K/V blocks rotate via ppermute.
+    # 'ring-flash': the ring with the Pallas flash kernel as its per-step
+    # block primitive (long-context: no dense score tile per step).
     # 'ulysses': sequence parallelism via all-to-all head redistribution
     # (tpuic/parallel/ulysses.py) — needs heads % seq-axis == 0.
-    # Both fall back to 'dense' numerics when the mesh has no seq sharding.
+    # All fall back to 'dense' numerics when the mesh has no seq sharding.
     attention: str = "dense"
     # Device mesh: keeps the flash kernel batch-parallel under a sharded jit
     # (shard_map over the 'data' axis) and carries the 'seq' axis for ring
@@ -77,6 +79,13 @@ class MultiHeadAttention(nn.Module):
               and self.mesh.shape.get("seq", 1) > 1):
             from tpuic.parallel import ring_attention
             out = ring_attention(q, k, v, self.mesh)
+        elif (self.attention == "ring-flash" and self.mesh is not None
+              and self.mesh.shape.get("seq", 1) > 1):
+            # Ring SP with the Pallas flash kernel as the per-step block
+            # primitive: O(N/P · D) activations instead of the dense
+            # ring's O(N/P · N/P) score tile.
+            from tpuic.parallel import ring_flash_attention
+            out = ring_flash_attention(q, k, v, self.mesh)
         elif (self.attention == "ulysses" and self.mesh is not None
               and self.mesh.shape.get("seq", 1) > 1):
             from tpuic.parallel import ulysses_attention

@@ -11,5 +11,6 @@ step IS the cross-host all_gather, ridden over ICI by GSPMD
 misclassified-id collection).
 """
 
-from tpuic.parallel.ring_attention import ring_attention  # noqa: F401
+from tpuic.parallel.ring_attention import (ring_attention,  # noqa: F401
+                                           ring_flash_attention)
 from tpuic.parallel.ulysses import ulysses_attention  # noqa: F401

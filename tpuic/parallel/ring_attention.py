@@ -42,6 +42,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _ring_perm(ring_size: int):
+    return [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+
 def _ring_step(qf, k, v, m, l, acc, *, step: int, axis_name: str,
                ring_size: int, n_valid: int, n_local: int):
     """One ring hop: score this device's current K/V block, fold into the
@@ -65,7 +69,7 @@ def _ring_step(qf, k, v, m, l, acc, *, step: int, axis_name: str,
         "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32)
     if step != ring_size - 1:
-        perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+        perm = _ring_perm(ring_size)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
     return k, v, m_new, l, acc
@@ -99,6 +103,33 @@ def _pad_tokens(t: jnp.ndarray, to: int) -> jnp.ndarray:
     return jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
 
 
+def _ring_wrapper(q, k, v, mesh, seq_axis, batch_axis, head_axis, make_body,
+                  **shard_map_kw):
+    """Shared wrapper for both ring variants: validate the seq axis, pad
+    tokens to a ring multiple, build the (batch, seq, head) PartitionSpec,
+    shard_map the per-device body from ``make_body(ring, n, n_local)``, and
+    slice the padding back off."""
+    if seq_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
+    ring = mesh.shape[seq_axis]
+    b, n, h, _ = q.shape
+    n_local = -(-n // ring)
+    n_padded = n_local * ring
+    q, k, v = (_pad_tokens(t, n_padded) for t in (q, k, v))
+
+    def _shardable(axis, dim):
+        return (axis is not None and axis in mesh.axis_names
+                and mesh.shape[axis] > 1 and dim % mesh.shape[axis] == 0)
+
+    spec = P(batch_axis if _shardable(batch_axis, b) else None, seq_axis,
+             head_axis if _shardable(head_axis, h) else None)
+    out = jax.shard_map(
+        make_body(ring, n, n_local), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, **shard_map_kw,
+    )(q, k, v)
+    return out[:, :n]
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
                    batch_axis: Optional[str] = "data",
                    head_axis: Optional[str] = "model"):
@@ -112,24 +143,143 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     Falls back to a single-block computation when the seq axis has size 1 —
     same numerics, no collectives.
     """
-    if seq_axis not in mesh.axis_names:
-        raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
-    ring = mesh.shape[seq_axis]
-    b, n, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
-    n_local = -(-n // ring)
-    n_padded = n_local * ring
-    q, k, v = (_pad_tokens(t, n_padded) for t in (q, k, v))
+    scale = 1.0 / (q.shape[-1] ** 0.5)
 
-    def _shardable(axis, dim):
-        return (axis is not None and axis in mesh.axis_names
-                and mesh.shape[axis] > 1 and dim % mesh.shape[axis] == 0)
+    def make_body(ring, n, n_local):
+        return functools.partial(_ring_local, axis_name=seq_axis,
+                                 ring_size=ring, n_valid=n, n_local=n_local,
+                                 scale=scale)
 
-    spec = P(batch_axis if _shardable(batch_axis, b) else None, seq_axis,
-             head_axis if _shardable(head_axis, h) else None)
-    out = jax.shard_map(
-        functools.partial(_ring_local, axis_name=seq_axis, ring_size=ring,
-                          n_valid=n, n_local=n_local, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-    )(q, k, v)
-    return out[:, :n]
+    return _ring_wrapper(q, k, v, mesh, seq_axis, batch_axis, head_axis,
+                         make_body)
+
+
+# -- ring + flash kernel composition -----------------------------------------
+#
+# The dense ring above materializes one [nq, n_local] score tile per step in
+# HBM; at long context (n_local in the thousands) that tile is itself the
+# memory/bandwidth problem flash attention exists to remove. ring-flash runs
+# the Pallas flash kernel WITHIN each ring step — per-device peak becomes
+# O(block² VMEM + n_local·D HBM) — and combines the per-step (out, lse)
+# pairs with a streaming logsumexp. The flash kernels take the step's key
+# validity as a device scalar (the rotating block id is only known at trace
+# time) and write lse = -1e30 for fully-masked rows so a fully-padded block
+# weighs ZERO in the combination (kernels' masked_sentinel).
+
+
+def _ringflash_combine(out, lse, o_i, lse_i, b, h, n_local):
+    """Fold one ring step's (o_i, lse_i) into the running (out, lse).
+
+    lse arrays are the kernels' folded [b*h, 1, nq_padded] layout; weights
+    are per (batch, head, token) — reshape to out's [b, n_local, h, 1]."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+
+    def w(x):  # [b*h, 1, nq_padded] -> [b, n_local, h, 1]
+        x = x.reshape(b, h, -1)[:, :, :n_local]
+        return jnp.transpose(x, (0, 2, 1))[..., None]
+
+    out_new = (out * w(jnp.exp(lse - lse_new))
+               + o_i.astype(jnp.float32) * w(jnp.exp(lse_i - lse_new)))
+    return out_new, lse_new
+
+
+def _block_valid(idx, step, ring_size, n_valid, n_local):
+    """Real-key count of the block this device holds at ``step`` (a traced
+    scalar: block ownership rotates). Fully-padded tail blocks yield 0."""
+    block_id = (idx - step) % ring_size
+    return jnp.clip(n_valid - block_id * n_local, 0, n_local).reshape(1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ringflash_local(q, k, v, axis_name, ring_size, n_valid, n_local,
+                     interpret):
+    out, _ = _ringflash_fwd_impl(q, k, v, axis_name, ring_size, n_valid,
+                                 n_local, interpret)
+    return out
+
+
+def _ringflash_fwd_impl(q, k, v, axis_name, ring_size, n_valid, n_local,
+                        interpret):
+    from tpuic.kernels.flash_attention import (_NEG_INF, _flash_fwd,
+                                               _resolve_blocks)
+    bq, bk = _resolve_blocks(n_local, None, None)
+    idx = lax.axis_index(axis_name)
+    b, _, h, _ = q.shape
+    out = lse = None
+    for step in range(ring_size):  # static: unrolled by trace
+        valid = _block_valid(idx, step, ring_size, n_valid, n_local)
+        o_i, lse_i = _flash_fwd(q, k, v, bq, bk, interpret, with_lse=True,
+                                valid=valid, masked_sentinel=_NEG_INF)
+        if out is None:
+            out, lse = o_i.astype(jnp.float32), lse_i
+        else:
+            out, lse = _ringflash_combine(out, lse, o_i, lse_i, b, h,
+                                          n_local)
+        if step != ring_size - 1:
+            perm = _ring_perm(ring_size)
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    return out.astype(q.dtype), lse
+
+
+def _ringflash_vjp_fwd(q, k, v, axis_name, ring_size, n_valid, n_local,
+                       interpret):
+    out, lse = _ringflash_fwd_impl(q, k, v, axis_name, ring_size, n_valid,
+                                   n_local, interpret)
+    # Residuals are O(n_local · D) + the lse row — never a score tile.
+    return out, (q, k, v, out, lse)
+
+
+def _ringflash_vjp_bwd(axis_name, ring_size, n_valid, n_local, interpret,
+                       res, g):
+    """Reverse ring: k/v rotate again, each step runs the blockwise flash
+    backward against the GLOBAL (out, lse), and the dk/dv accumulators
+    travel with their blocks — after ring_size rotations they are home."""
+    from tpuic.kernels.flash_attention import _flash_bwd, _resolve_blocks
+    q, k, v, out, lse = res
+    kdt, vdt = k.dtype, v.dtype
+    bq, bk = _resolve_blocks(n_local, None, None)
+    idx = lax.axis_index(axis_name)
+    do = g
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    perm = _ring_perm(ring_size)
+    for step in range(ring_size):
+        valid = _block_valid(idx, step, ring_size, n_valid, n_local)
+        dq_i, dk_i, dv_i = _flash_bwd(q, k, v, out, lse, do, bq, bk,
+                                      interpret, valid=valid)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk = dk + dk_i.astype(jnp.float32)
+        dv = dv + dv_i.astype(jnp.float32)
+        # Rotate every step (incl. the last): ring_size hops return the
+        # k/dk/v/dv buffers to their owners.
+        k, v, dk, dv = (lax.ppermute(t, axis_name, perm)
+                        for t in (k, v, dk, dv))
+    return dq.astype(q.dtype), dk.astype(kdt), dv.astype(vdt)
+
+
+_ringflash_local.defvjp(_ringflash_vjp_fwd, _ringflash_vjp_bwd)
+
+
+def ring_flash_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+                         batch_axis: Optional[str] = "data",
+                         head_axis: Optional[str] = "model",
+                         interpret: Optional[bool] = None):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    primitive — same signature and semantics as :func:`ring_attention`,
+    O(N/P · D) per-device activation memory instead of the dense ring's
+    O(N/P · N/P) score tile. See the module-section comment above."""
+    if interpret is None:
+        from tpuic.kernels import default_interpret
+        interpret = default_interpret()
+
+    def make_body(ring, n, n_local):
+        # nondiff_argnums are positional: keywords would bypass custom_vjp's
+        # argument bookkeeping.
+        return lambda q_, k_, v_: _ringflash_local(
+            q_, k_, v_, seq_axis, ring, n, n_local, interpret)
+
+    return _ring_wrapper(q, k, v, mesh, seq_axis, batch_axis, head_axis,
+                         make_body,
+                         check_vma=False)  # pallas outs carry no vma

@@ -116,7 +116,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(weight-update sharding: params stay replicated, "
                         "1/N Adam memory; subsumed by --fsdp)")
     p.add_argument("--attention", default="dense",
-                   choices=["dense", "flash", "ring", "ulysses"],
+                   choices=["dense", "flash", "ring", "ring-flash",
+                            "ulysses"],
                    help="attention implementation for ViT backbones")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the forward in backward (trade FLOPs "
