@@ -114,9 +114,10 @@ def main():
     ap.add_argument("--batches", default="64,128,256")
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--size", type=int, default=224)
+    from tpuic.models import ATTENTION_IMPLS
     ap.add_argument("--attention", default="dense",
-                    help="vit attention impl: "
-                         "dense|flash|ring|ring-flash|ulysses")
+                    choices=list(ATTENTION_IMPLS),
+                    help="vit attention impl")
     ap.add_argument("--fused-loss", action="store_true",
                     help="Pallas fused cross-entropy")
     ap.add_argument("--spmd", action="store_true",

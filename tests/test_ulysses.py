@@ -44,6 +44,27 @@ class TestUlysses:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    # 24: padded (24 % 4 == 0 but kernel pads to 128); 10: caller padding
+    # (10 % 4 != 0 -> ulysses pads to 12, flash masks via valid_len).
+    @pytest.mark.parametrize("n", [24, 10])
+    def test_flash_local_matches_dense_fwd_and_bwd(self, devices8, n):
+        """attention='ulysses-flash': the head-sharded local attention runs
+        through the Pallas flash kernel (valid_len masks caller padding)."""
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q, k, v = (_rand(i + 50, (2, n, 4, 8)) for i in range(3))
+        got = ulysses_attention(q, k, v, mesh, use_flash=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dense(q, k, v)),
+                                   rtol=1e-4, atol=1e-4)
+        g1 = jax.grad(
+            lambda *a: jnp.sum(
+                ulysses_attention(*a, mesh, use_flash=True) ** 2),
+            (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(_dense(*a) ** 2), (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_indivisible_heads_raises(self, devices8):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
         q = jnp.zeros((2, 16, 3, 8))  # 3 heads, P=4
@@ -70,20 +91,24 @@ class TestUlysses:
 
 
 class TestUlyssesViT:
-    def test_ulysses_vit_matches_dense_vit(self, devices8):
+    @pytest.mark.parametrize("impl", ["ulysses", "ulysses-flash"])
+    def test_ulysses_vit_matches_dense_vit(self, devices8, impl):
         from tpuic.models import create_model
 
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
         dense = create_model("vit-tiny", 7, dtype="float32", attention="dense")
         uly = create_model("vit-tiny", 7, dtype="float32",
-                           attention="ulysses", mesh=mesh)
+                           attention=impl, mesh=mesh)
         x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
         variables = dense.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)),
                                train=False)
         a = dense.apply(variables, x, train=False)
         b = uly.apply(variables, x, train=False)
+        # Plain ulysses keeps the original tight tolerance; the flash
+        # local path accumulates blockwise (online softmax) and gets 1e-4.
+        tol = 1e-5 if impl == "ulysses" else 1e-4
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=tol, atol=tol)
 
 
 class TestUlyssesWithTP:

@@ -110,8 +110,10 @@ class ModelConfig:
     # tpuic/kernels/flash_attention.py), 'ring' (sequence-parallel ring
     # attention over the mesh 'seq' axis, tpuic/parallel/ring_attention.py),
     # 'ring-flash' (the ring with the flash kernel as its per-step block
-    # primitive — long-context), or 'ulysses' (sequence-parallel all-to-all
-    # head redistribution, tpuic/parallel/ulysses.py). CNNs ignore this.
+    # primitive — long-context), 'ulysses' (sequence-parallel all-to-all
+    # head redistribution, tpuic/parallel/ulysses.py), or 'ulysses-flash'
+    # (ulysses with its head-sharded local attention run through the flash
+    # kernel). CNNs ignore this.
     attention: str = "dense"
 
 

@@ -194,16 +194,21 @@ def _resolve_blocks(n: int, block_q, block_k):
 
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
                                              "interpret", "with_lse",
-                                             "masked_sentinel"))
+                                             "masked_sentinel",
+                                             "static_valid"))
 def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
                with_lse: bool = False, valid=None,
-               masked_sentinel: float = 0.0):
+               masked_sentinel: float = 0.0, static_valid=None):
     """q,k,v: [B, N, H, D] -> out [B, N, H, D] (and logsumexp [B*H, N_padded]
     when with_lse — the backward residual). Single-device (or per-shard).
 
     ``valid``: optional [1] int32 device scalar overriding the static key
-    validity count (the ring composition's rotating block ownership)."""
+    validity count (the ring composition's rotating block ownership).
+    ``static_valid``: compile-time override for callers whose inputs carry
+    MORE padding than the block rounding (ulysses pads tokens to the seq
+    axis before the kernel sees them)."""
     b, n, h, d = q.shape
+    valid_len = n if static_valid is None else static_valid
     scale = 1.0 / (d ** 0.5)
     n_padded = _padded_len(n, block_q, block_k)
 
@@ -245,7 +250,7 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool,
         lse_ref = rest[1] if with_lse else None
         scratch = rest[2:] if with_lse else rest[1:]
         _fwd_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, *scratch,
-                    block_k=block_k, scale=scale, valid_len=n,
+                    block_k=block_k, scale=scale, valid_len=valid_len,
                     n_k_blocks=n_k_blocks, masked_sentinel=masked_sentinel)
 
     res = pl.pallas_call(
@@ -355,13 +360,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "static_valid"))
 def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
-               interpret: bool, valid=None):
+               interpret: bool, valid=None, static_valid=None):
     """Blockwise backward: (dq, dk, dv), each [B, N, H, D]. lse is the folded
-    [B*H, 1, N_padded] logsumexp saved by the forward. ``valid`` as in
-    :func:`_flash_fwd`."""
+    [B*H, 1, N_padded] logsumexp saved by the forward. ``valid`` /
+    ``static_valid`` as in :func:`_flash_fwd`."""
     b, n, h, d = q.shape
+    valid_len = n if static_valid is None else static_valid
     scale = 1.0 / (d ** 0.5)
     n_padded = _padded_len(n, block_q, block_k)
 
@@ -400,7 +406,8 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
             *ins, dq_ref, acc_s = refs
             valid_ref = None
         _bwd_dq_kernel(*ins, valid_ref, dq_ref, acc_s, block_k=block_k,
-                       scale=scale, valid_len=n, n_k_blocks=n_k_blocks)
+                       scale=scale, valid_len=valid_len,
+                       n_k_blocks=n_k_blocks)
 
     dq = pl.pallas_call(
         _dq_kernel,
@@ -425,7 +432,7 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
             *ins, dk_ref, dv_ref, dk_s, dv_s = refs
             valid_ref = None
         _bwd_dkv_kernel(*ins, valid_ref, dk_ref, dv_ref, dk_s, dv_s,
-                        block_q=block_q, scale=scale, valid_len=n,
+                        block_q=block_q, scale=scale, valid_len=valid_len,
                         n_q_blocks=n_q_blocks)
 
     dk, dv = pl.pallas_call(
@@ -458,19 +465,22 @@ def _shard_batch(mesh: Optional[Mesh], b: int) -> bool:
     return n_data > 1 and b % n_data == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
-                    mesh: Optional[Mesh] = None):
+                    mesh: Optional[Mesh] = None,
+                    valid_len: Optional[int] = None):
     """Softmax attention, [B, N, H, D] in/out, no causal mask (ViT is
     bidirectional). ``block_q``/``block_k`` default to a length-adaptive
     size (``_resolve_blocks``); ``interpret=None`` auto-selects interpret
     mode off-TPU; ``mesh`` keeps the kernel batch-parallel under a sharded
-    jit (see module docstring)."""
+    jit (see module docstring); ``valid_len`` masks keys beyond a static
+    count when the inputs carry caller-side padding (ulysses)."""
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     return _batch_parallel(
-        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp),
+        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
+                                        static_valid=valid_len),
         mesh, interpret, 1, q, k, v)
 
 
@@ -494,21 +504,23 @@ def _batch_parallel(fn, mesh, interpret, n_out, *operands):
     )(*operands)
 
 
-def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh):
+def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh, valid_len=None):
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     out, lse = _batch_parallel(
         lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
-                                        with_lse=True),
+                                        with_lse=True,
+                                        static_valid=valid_len),
         mesh, interpret, 2, q, k, v)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(block_q, block_k, interpret, mesh, res, g):
+def _vjp_bwd(block_q, block_k, interpret, mesh, valid_len, res, g):
     q, k, v, out, lse = res
     # Same resolution as the forward: lse was padded with these blocks.
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     return _batch_parallel(
-        lambda interp, *ops: _flash_bwd(*ops, block_q, block_k, interp),
+        lambda interp, *ops: _flash_bwd(*ops, block_q, block_k, interp,
+                                        static_valid=valid_len),
         mesh, interpret, 3, q, k, v, out, lse, g)
 
 

@@ -34,7 +34,8 @@ def available_models():
     return sorted(_REGISTRY)
 
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses")
+# Single source of truth: the module whose attention dispatch consumes it.
+from tpuic.models.vit import ATTENTION_IMPLS  # noqa: E402,F401
 
 
 def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,

@@ -32,7 +32,8 @@ def _dense(features, name, dtype, param_dtype, logical):
     )
 
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses",
+                   "ulysses-flash")
 
 
 class MultiHeadAttention(nn.Module):
@@ -86,10 +87,13 @@ class MultiHeadAttention(nn.Module):
             # ring's O(N/P · N/P) score tile.
             from tpuic.parallel import ring_flash_attention
             out = ring_flash_attention(q, k, v, self.mesh)
-        elif (self.attention == "ulysses" and self.mesh is not None
+        elif (self.attention in ("ulysses", "ulysses-flash")
+              and self.mesh is not None
               and self.mesh.shape.get("seq", 1) > 1):
             from tpuic.parallel import ulysses_attention
-            out = ulysses_attention(q, k, v, self.mesh)
+            out = ulysses_attention(
+                q, k, v, self.mesh,
+                use_flash=self.attention == "ulysses-flash")
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

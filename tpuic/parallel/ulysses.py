@@ -49,7 +49,9 @@ def _local_attention(q, k, v, *, scale: float, n_valid: int):
     return out.astype(q.dtype)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, scale: float, n_valid: int):
+def _ulysses_local(q, k, v, *, axis_name: str, scale: float, n_valid: int,
+                   use_flash: bool = False,
+                   interpret: Optional[bool] = None):
     """Per-device body under shard_map: seq-sharded in, seq-sharded out."""
     # [B, N/P, H, D] -> [B, N, H/P, D]: gather sequence, scatter heads.
     def to_heads(t):
@@ -60,8 +62,17 @@ def _ulysses_local(q, k, v, *, axis_name: str, scale: float, n_valid: int):
         return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    out = _local_attention(to_heads(q), to_heads(k), to_heads(v),
-                           scale=scale, n_valid=n_valid)
+    if use_flash:
+        # Head-sharded attention is an ordinary full-sequence call — the
+        # flash kernel drops in directly (heads are independent). valid_len
+        # masks the caller-side token padding; padded positions beyond it
+        # never reach softmax.
+        from tpuic.kernels import flash_attention
+        out = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+                              None, None, interpret, None, n_valid)
+    else:
+        out = _local_attention(to_heads(q), to_heads(k), to_heads(v),
+                               scale=scale, n_valid=n_valid)
     return to_seq(out)
 
 
@@ -74,7 +85,9 @@ def _pad_tokens(t: jnp.ndarray, to: int) -> jnp.ndarray:
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
                       batch_axis: Optional[str] = "data",
-                      head_axis: Optional[str] = "model"):
+                      head_axis: Optional[str] = "model",
+                      use_flash: bool = False,
+                      interpret: Optional[bool] = None):
     """Bidirectional softmax attention, [B, N, H, D] in/out, with the token
     dim sharded over ``mesh.shape[seq_axis]`` and heads redistributed by
     all-to-all for the attention itself. Composes with batch sharding over
@@ -82,7 +95,12 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     axis already shards heads, the all-to-all only redistributes each TP
     rank's local heads over the seq axis (needs (H/tp) % P == 0) instead of
     all-gathering the head-sharded QKV. Falls back to a single local
-    computation when the seq axis has size 1."""
+    computation when the seq axis has size 1.
+
+    ``use_flash`` runs the head-sharded local attention through the Pallas
+    flash kernel (attention='ulysses-flash'): no [N, N] score tile in HBM,
+    so ulysses stays viable at sequence lengths where the dense local
+    softmax would dominate memory."""
     if seq_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no '{seq_axis}' axis: {mesh.axis_names}")
     p = mesh.shape[seq_axis]
@@ -108,7 +126,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
              head_axis if hshard else None)
     out = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=seq_axis, scale=scale,
-                          n_valid=n),
+                          n_valid=n, use_flash=use_flash,
+                          interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **({"check_vma": False} if use_flash else {}),  # pallas: no vma
     )(q, k, v)
     return out[:, :n]

@@ -115,9 +115,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard ONLY the optimizer moments over the data axis "
                         "(weight-update sharding: params stay replicated, "
                         "1/N Adam memory; subsumed by --fsdp)")
+    from tpuic.models import ATTENTION_IMPLS
     p.add_argument("--attention", default="dense",
-                   choices=["dense", "flash", "ring", "ring-flash",
-                            "ulysses"],
+                   choices=list(ATTENTION_IMPLS),
                    help="attention implementation for ViT backbones")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the forward in backward (trade FLOPs "
