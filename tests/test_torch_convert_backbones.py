@@ -244,3 +244,88 @@ def test_efficientnet_mlp_head_replica_roundtrip():
         np.testing.assert_array_equal(sd1[k], v, err_msg=k)
     tm.load_state_dict({k: torch.as_tensor(np.asarray(v))
                         for k, v in sd1.items()})
+
+
+# ---------------------------------------------------------------------------
+# ViT (torchvision vision_transformer module naming)
+# ---------------------------------------------------------------------------
+
+def test_vit_forward_parity():
+    """torchvision-naming ViT replica -> convert_vit -> tpuic ViT: exact
+    logits parity (MultiheadAttention in_proj/out_proj vs the fused qkv
+    kernel, cls/pos embedding layout, pre-LN blocks, MLP head)."""
+    from tpuic.checkpoint.torch_convert import convert_vit
+    from tpuic.checkpoint.torch_ref import build_vit
+
+    torch.manual_seed(11)
+    tm = build_vit("vit-tiny", num_classes=7, image_size=16).eval()
+    x = np.random.default_rng(12).normal(
+        size=(2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+
+    tree = convert_vit(tm.state_dict())
+    model = create_model("vit-tiny", 7, dtype="float32")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)),
+                           train=False)
+    merged_p, n_loaded, n_total = lenient_restore(
+        dict(variables["params"]), tree["params"])
+    assert n_loaded == n_total, f"only {n_loaded}/{n_total} params mapped"
+    got = model.apply({"params": merged_p}, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
+
+
+def test_detect_vit():
+    from tpuic.checkpoint.torch_convert import detect_vit_variant
+
+    sd = {"class_token": np.zeros((1, 1, 768), np.float32),
+          "conv_proj.weight": np.zeros((768, 3, 16, 16), np.float32)}
+    assert detect_arch(sd) == "vit"
+    assert detect_vit_variant(sd) == "vit-b16"
+    sd384 = {"conv_proj.weight": np.zeros((384, 3, 16, 16), np.float32)}
+    assert detect_vit_variant(sd384) == "vit-s16"
+    with pytest.raises(ValueError, match="no tpuic ViT"):
+        detect_vit_variant({"conv_proj.weight":
+                            np.zeros((123, 3, 16, 16), np.float32)})
+
+
+def test_export_vit_roundtrips():
+    """tpuic ViT params -> export_vit -> convert_vit: bitwise identity, and
+    the torch replica loads the exported dict strictly."""
+    from tpuic.checkpoint.torch_convert import convert_vit, export_vit
+    from tpuic.checkpoint.torch_ref import build_vit
+
+    from flax.linen import meta
+
+    model = create_model("vit-tiny", 5, dtype="float32")
+    variables = model.init(jax.random.key(3), jnp.zeros((1, 16, 16, 3)),
+                           train=False)
+    # unbox the logical-partitioning metadata: export/compare plain arrays
+    params = jax.tree.map(np.asarray, meta.unbox(dict(variables["params"])))
+    sd = export_vit(params, {}, prefix="")
+    tree = convert_vit(sd)
+
+    flat0 = {jax.tree_util.keystr(p): v for p, v in
+             jax.tree_util.tree_flatten_with_path(dict(params))[0]}
+    flat1 = {jax.tree_util.keystr(p): v for p, v in
+             jax.tree_util.tree_flatten_with_path(tree["params"])[0]}
+    assert set(flat0) == set(flat1)
+    for p in flat0:
+        np.testing.assert_array_equal(np.asarray(flat0[p]),
+                                      np.asarray(flat1[p]), err_msg=p)
+
+    tm = build_vit("vit-tiny", num_classes=5, image_size=16)
+    tm.load_state_dict({k: torch.as_tensor(np.asarray(v))
+                        for k, v in sd.items()})
+
+
+def test_export_vit_moe_raises():
+    """MoE ViTs have no torch layout: export must fail loudly instead of
+    silently dropping every expert/router weight."""
+    from tpuic.checkpoint.torch_convert import export_state_dict
+
+    model = create_model("vit-tiny-moe", 3, dtype="float32")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)),
+                           train=False)
+    with pytest.raises(ValueError, match="Switch-MoE"):
+        export_state_dict(dict(variables["params"]), {})

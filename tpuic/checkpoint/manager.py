@@ -61,6 +61,11 @@ def lenient_restore(current: Dict, restored: Dict) -> Tuple[Dict, int, int]:
 
     Returns (merged tree, n_loaded, n_total_current). A leaf is taken from
     ``restored`` iff its path exists in both trees and shapes match.
+
+    Leaves wrapped in flax AxisMetadata boxes (``with_logical_partitioning``
+    kernels — the ViT/TP models) are compared and replaced by their
+    ``.value`` with the box preserved, so sharding metadata survives a
+    torch-init or cross-architecture merge.
     """
     cur = _flatten(current)
     res = _flatten(restored)
@@ -68,8 +73,14 @@ def lenient_restore(current: Dict, restored: Dict) -> Tuple[Dict, int, int]:
     merged = {}
     for path, leaf in cur.items():
         r = res.get(path)
-        if r is not None and getattr(r, "shape", None) == getattr(leaf, "shape", None):
-            merged[path] = np.asarray(r).astype(leaf.dtype) if hasattr(leaf, "dtype") else r
+        target = getattr(leaf, "value", leaf)   # unbox AxisMetadata
+        rv = getattr(r, "value", r)
+        if rv is not None and getattr(rv, "shape", None) == getattr(
+                target, "shape", None):
+            new = (np.asarray(rv).astype(target.dtype)
+                   if hasattr(target, "dtype") else rv)
+            merged[path] = (leaf.replace_boxed(new)
+                            if hasattr(leaf, "replace_boxed") else new)
             loaded += 1
         else:
             merged[path] = leaf

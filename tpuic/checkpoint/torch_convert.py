@@ -409,6 +409,137 @@ def convert_efficientnet(state_dict: Mapping[str, Any], variant: str = "b3",
 # Dispatch
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# ViT (torchvision vision_transformer naming: vit_b_16 / vit_l_16 / ...)
+# ---------------------------------------------------------------------------
+
+# torchvision encoder-block leaf -> (tpuic module path, is_layernorm)
+_VIT_LN = {"ln_1": "ln1", "ln_2": "ln2"}
+# both torchvision MLP namings: >=0.12 Sequential indices, older linear_N
+_VIT_MLP = {"mlp.0": "mlp_up", "mlp.3": "mlp_down",
+            "mlp.linear_1": "mlp_up", "mlp.linear_2": "mlp_down"}
+
+_VIT_LAYER_RE = re.compile(r"^layers\.encoder_layer_(\d+)\.(.+)$")
+
+
+def convert_vit(state_dict: Mapping[str, Any],
+                backbone_scope: str = "backbone",
+                head_scope: str = "head") -> Dict[str, Dict]:
+    """torchvision ``vit_{b,l}_16``-style state_dict -> tpuic ViT trees.
+
+    Key facts of the mapping (torchvision VisionTransformer):
+    - ``conv_proj`` is the patch embedding (OIHW -> HWIO);
+    - ``class_token``/``encoder.pos_embedding`` carry the same
+      (cls-first, row-major patches) layout as tpuic's ``cls``/``pos_embed``;
+    - ``self_attention`` is ``nn.MultiheadAttention``: ``in_proj_weight``
+      is the stacked [3D, D] with rows [q; k; v] — its transpose is exactly
+      tpuic's fused ``qkv`` kernel [D, 3D] (models/vit.py splits columns in
+      q,k,v order, and both sides split heads contiguously);
+    - ``encoder.ln`` is the final LayerNorm (-> ``ln_final``);
+    - ``heads.head`` maps onto the tpuic head scope (a single Linear lands
+      on 'out' and is shape-skipped by lenient_restore unless it matches —
+      the reference's re-head semantics; an MLP-head Sequential maps fully).
+    ViT has no BatchNorm: ``batch_stats`` is returned empty.
+    """
+    # ViT keys legitimately carry an inner 'encoder.' scope
+    # (encoder.pos_embedding, encoder.layers...). strip_prefixes removes
+    # ONE leading wrapper per kind, so a reference-wrapped checkpoint
+    # ('module.encoder.' + torchvision keys) still leaves that inner scope
+    # on some keys — normalize it off here.
+    sd = {}
+    for k, v in strip_prefixes(state_dict).items():
+        if k.startswith("encoder."):
+            k = k[len("encoder."):]
+        sd[k] = v
+    head_keys = {k[len("heads.head."):]: k for k in sd
+                 if k.startswith("heads.head.")}
+    # Sequential head indices -> fc0..fcK-1/out (same rule as
+    # _head_fc_mapping, derived from the head's own Linear indices).
+    idxs = sorted({int(m.group(1)) for k in head_keys
+                   if (m := re.match(r"(\d+)\.(weight|bias)$", k))})
+    head_map = {str(i): (f"fc{n}" if n < len(idxs) - 1 else "out")
+                for n, i in enumerate(idxs)}
+    params: Dict = {}
+
+    def put_ln(scope: Tuple[str, ...], leaf: str, v: np.ndarray) -> None:
+        if leaf == "weight":
+            _set(params, scope + ("scale",), v)
+        elif leaf == "bias":
+            _set(params, scope + ("bias",), v)
+
+    for key, v in sd.items():
+        if key == "class_token":
+            _set(params, (backbone_scope, "cls"), v)
+            continue
+        if key == "conv_proj.weight":
+            _set(params, (backbone_scope, "patch_embed", "kernel"), _conv(v))
+            continue
+        if key == "conv_proj.bias":
+            _set(params, (backbone_scope, "patch_embed", "bias"), v)
+            continue
+        if key == "pos_embedding":
+            _set(params, (backbone_scope, "pos_embed"), v)
+            continue
+        if key in ("ln.weight", "ln.bias"):
+            put_ln((backbone_scope, "ln_final"), key.split(".")[1], v)
+            continue
+        m = _VIT_LAYER_RE.match(key)
+        if m:
+            block = (backbone_scope, f"block{m.group(1)}")
+            inner, leaf = m.group(2).rsplit(".", 1)
+            if inner in _VIT_LN:
+                put_ln(block + (_VIT_LN[inner],), leaf, v)
+            elif inner == "self_attention" and leaf == "in_proj_weight":
+                _set(params, block + ("attn", "qkv", "kernel"), _linear(v))
+            elif inner == "self_attention" and leaf == "in_proj_bias":
+                _set(params, block + ("attn", "qkv", "bias"), v)
+            elif inner == "self_attention.out_proj":
+                if leaf == "weight":
+                    _set(params, block + ("attn", "out", "kernel"),
+                         _linear(v))
+                elif leaf == "bias":
+                    _set(params, block + ("attn", "out", "bias"), v)
+            elif inner in _VIT_MLP:
+                if leaf == "weight":
+                    _set(params, block + (_VIT_MLP[inner], "kernel"),
+                         _linear(v))
+                elif leaf == "bias":
+                    _set(params, block + (_VIT_MLP[inner], "bias"), v)
+            continue
+        if key.startswith("heads.head."):
+            rest = key[len("heads.head."):]
+            parts = rest.rsplit(".", 1)
+            if len(parts) == 1:  # bare heads.head.{weight,bias}: one Linear
+                target, leaf = "out", parts[0]
+            else:
+                target, leaf = head_map.get(parts[0]), parts[1]
+            if target is None:
+                continue
+            if leaf == "weight":
+                _set(params, (head_scope, target, "kernel"), _linear(v))
+            elif leaf == "bias":
+                _set(params, (head_scope, target, "bias"), v)
+
+    return {"params": params, "batch_stats": {}}
+
+
+def detect_vit_variant(state_dict: Mapping[str, Any]) -> str:
+    """tpuic model name from the patch-embedding shape [D, 3, p, p]."""
+    sd = strip_prefixes(state_dict)
+    w = sd.get("conv_proj.weight")
+    if w is None:
+        raise ValueError("no conv_proj.weight in state_dict")
+    hidden, _, patch, _ = w.shape
+    names = {(768, 16): "vit-b16", (1024, 16): "vit-l16",
+             (384, 16): "vit-s16", (64, 4): "vit-tiny"}
+    name = names.get((int(hidden), int(patch)))
+    if name is None:
+        raise ValueError(
+            f"no tpuic ViT for hidden={hidden}, patch={patch} "
+            f"(supported: {sorted(names.values())})")
+    return name
+
+
 def detect_arch(state_dict: Mapping[str, Any]) -> str:
     """Sniff the backbone family from state_dict key shapes."""
     for k in state_dict:
@@ -417,6 +548,8 @@ def detect_arch(state_dict: Mapping[str, Any]) -> str:
             return "inceptionv3"
         if k.startswith("_blocks.") or k.startswith("_conv_stem"):
             return "efficientnet"
+        if k == "class_token" or k.startswith("conv_proj."):
+            return "vit"
         if k.startswith("layer1.") or k == "conv1.weight":
             return "resnet"
     raise ValueError("could not detect backbone family from state_dict keys")
@@ -440,7 +573,8 @@ def convert_state_dict(state_dict: Mapping[str, Any],
                        arch: str = "auto", **kw) -> Dict[str, Dict]:
     """Convert any supported torch state_dict to tpuic trees.
 
-    ``arch``: 'auto' | 'resnet*' | 'inceptionv3' | 'efficientnet-b{0..7}'.
+    ``arch``: 'auto' | 'resnet*' | 'inceptionv3' | 'efficientnet-b{0..7}'
+    | 'vit*'.
     """
     if arch == "auto":
         arch = detect_arch(state_dict)
@@ -448,6 +582,8 @@ def convert_state_dict(state_dict: Mapping[str, Any],
         return convert_resnet(state_dict, **kw)
     if arch.startswith("inception"):
         return convert_inception(state_dict, **kw)
+    if arch.startswith("vit"):
+        return convert_vit(state_dict, **kw)
     if arch.startswith("efficientnet"):
         # Bare 'efficientnet' (from auto-detection): the variant is derivable
         # from the checkpoint — guessing one would silently mis-key every
@@ -715,6 +851,61 @@ def export_efficientnet(params: Mapping[str, Any],
     return {prefix + k: v for k, v in sd.items()}
 
 
+def export_vit(params: Mapping[str, Any],
+               batch_stats: Mapping[str, Any],
+               prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
+    """tpuic ViT trees -> torchvision vision_transformer-layout state_dict —
+    the inverse of ``convert_vit`` (current >=0.12 ``mlp.{0,3}`` naming).
+    ``batch_stats`` is accepted for dispatch symmetry; ViT has none."""
+    del batch_stats
+    bb = params.get("backbone", {})
+    if "patch_embed" not in bb:
+        raise ValueError(
+            "export_vit: params['backbone'] has no 'patch_embed' — not a "
+            f"ViT checkpoint (got {sorted(bb)[:6]}...)")
+    sd: Dict[str, np.ndarray] = {}
+    sd["class_token"] = _unbox(bb["cls"])
+    sd["conv_proj.weight"] = _conv_inv(bb["patch_embed"]["kernel"])
+    sd["conv_proj.bias"] = _unbox(bb["patch_embed"]["bias"])
+    sd["encoder.pos_embedding"] = _unbox(bb["pos_embed"])
+    sd["encoder.ln.weight"] = _unbox(bb["ln_final"]["scale"])
+    sd["encoder.ln.bias"] = _unbox(bb["ln_final"]["bias"])
+    ln_inv = {v: k for k, v in _VIT_LN.items()}
+    mlp_inv = {"mlp_up": "mlp.0", "mlp_down": "mlp.3"}
+    for name, sub in bb.items():
+        if not name.startswith("block"):
+            continue
+        if "moe" in sub:
+            # Switch-MoE experts/router have no torchvision layout —
+            # exporting would silently drop every MoE MLP.
+            raise ValueError(
+                f"export_vit: {name} contains a Switch-MoE MLP; MoE ViTs "
+                "(vit-*-moe) have no torch export target")
+        t = f"encoder.layers.encoder_layer_{name[len('block'):]}"
+        for mod, leaves in sub.items():
+            if mod in ln_inv:
+                sd[f"{t}.{ln_inv[mod]}.weight"] = _unbox(leaves["scale"])
+                sd[f"{t}.{ln_inv[mod]}.bias"] = _unbox(leaves["bias"])
+            elif mod == "attn":
+                sd[f"{t}.self_attention.in_proj_weight"] = np.transpose(
+                    _unbox(leaves["qkv"]["kernel"]))
+                sd[f"{t}.self_attention.in_proj_bias"] = _unbox(
+                    leaves["qkv"]["bias"])
+                sd[f"{t}.self_attention.out_proj.weight"] = np.transpose(
+                    _unbox(leaves["out"]["kernel"]))
+                sd[f"{t}.self_attention.out_proj.bias"] = _unbox(
+                    leaves["out"]["bias"])
+            elif mod in mlp_inv:
+                sd[f"{t}.{mlp_inv[mod]}.weight"] = np.transpose(
+                    _unbox(leaves["kernel"]))
+                sd[f"{t}.{mlp_inv[mod]}.bias"] = _unbox(leaves["bias"])
+    # Head: _export_head emits fc.* keys ('fc.N.*' for the MLP Sequential,
+    # bare 'fc.weight' for one Linear); torchvision's scope is heads.head.
+    for k, v in _export_head(params.get("head", {})).items():
+        sd["heads.head." + k[len("fc."):]] = v
+    return {prefix + k: v for k, v in sd.items()}
+
+
 def export_state_dict(params: Mapping[str, Any],
                       batch_stats: Mapping[str, Any],
                       prefix: str = "module.encoder.") -> Dict[str, np.ndarray]:
@@ -726,10 +917,12 @@ def export_state_dict(params: Mapping[str, Any],
         return export_inception(params, batch_stats, prefix)
     if "stem_conv" in bb:
         return export_efficientnet(params, batch_stats, prefix)
+    if "patch_embed" in bb:
+        return export_vit(params, batch_stats, prefix)
     raise ValueError(
         "export_state_dict: unsupported backbone for torch export "
         f"(got {sorted(bb)[:6]}...); supported: resnet*, inceptionv3, "
-        "efficientnet-b*")
+        "efficientnet-b*, vit*")
 
 
 # ---------------------------------------------------------------------------
@@ -746,6 +939,13 @@ def _infer_head(state_dict: Mapping[str, Any]) -> Tuple[int, bool]:
     for k in ("fc.bias", "_fc.bias"):   # plain torchvision / effnet _fc
         if k in flat:
             return int(flat[k].shape[0]), False
+    # ViT scope (torchvision heads.head): Sequential MLP or one Linear.
+    hh = sorted(int(m.group(1)) for k in flat
+                if (m := re.match(r"heads\.head\.(\d+)\.bias$", k)))
+    if hh:
+        return int(flat[f"heads.head.{hh[-1]}.bias"].shape[0]), len(hh) > 1
+    if "heads.head.bias" in flat:
+        return int(flat["heads.head.bias"].shape[0]), False
     raise ValueError("cannot infer num_classes: no fc head keys found")
 
 
@@ -823,6 +1023,8 @@ def main(argv=None) -> int:
         arch = f"efficientnet-{detect_efficientnet_variant(sd)}"
     elif arch == "resnet":
         arch = detect_resnet_depth(sd)
+    elif arch == "vit":
+        arch = detect_vit_variant(sd)
     tree = convert_state_dict(sd, arch=arch)
     n_params = len([1 for _ in _iter_leaves(tree["params"])])
     n_stats = len([1 for _ in _iter_leaves(tree["batch_stats"])])
@@ -844,22 +1046,44 @@ def main(argv=None) -> int:
     from tpuic.checkpoint.torch_ref import build_reference_model
     from tpuic.models import create_model
 
-    replica = build_reference_model(arch, num_classes,
-                                    mlp_head=mlp_head).eval()
+    size = args.image_size
+    if arch.startswith("vit"):
+        # The pos-embedding length fixes the ViT's image size: verify at
+        # the checkpoint's own size, whatever --image-size says.
+        flat = strip_prefixes(sd)
+        pe = flat.get("pos_embedding", flat.get("encoder.pos_embedding"))
+        patch = flat["conv_proj.weight"].shape[-1]
+        if pe is not None:
+            size = int(patch) * int(round((pe.shape[1] - 1) ** 0.5))
+    replica = build_reference_model(arch, num_classes, mlp_head=mlp_head,
+                                    image_size=size).eval()
     # strip_prefixes normalizes to numpy for the converter; torch's
     # load_state_dict wants tensors back.
-    stripped = {k: torch.as_tensor(np.asarray(v))
-                for k, v in strip_prefixes(sd).items()}
+    if arch.startswith("vit"):
+        # ViT keys carry a REAL inner 'encoder.' scope the replica expects:
+        # strip only the DDP wrapper, plus one 'encoder.' when the
+        # checkpoint is reference-wrapped (no bare conv_proj at top level).
+        raw = {(k[len("module."):] if k.startswith("module.") else k): v
+               for k, v in sd.items()}
+        if not any(k.startswith("conv_proj") for k in raw):
+            raw = {(k[len("encoder."):] if k.startswith("encoder.")
+                    else k): v for k, v in raw.items()}
+        stripped = {k: torch.as_tensor(np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v))
+            for k, v in raw.items()}
+    else:
+        stripped = {k: torch.as_tensor(np.asarray(v))
+                    for k, v in strip_prefixes(sd).items()}
     missing, unexpected = replica.load_state_dict(stripped, strict=False)
     kw = {} if mlp_head else {"head_widths": ()}
     model = create_model(arch, num_classes, dtype="float32", **kw)
-    size = args.image_size
     variables = model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)),
                            train=False)
     merged_p, n_loaded, n_total = lenient_restore(
         dict(variables["params"]), tree["params"])
     merged_s, n_s, n_s_total = lenient_restore(
-        dict(variables["batch_stats"]), tree["batch_stats"])
+        dict(variables.get("batch_stats", {})),  # ViT: no BN collection
+        tree["batch_stats"])
     x = np.random.default_rng(0).normal(
         size=(args.batch, size, size, 3)).astype(np.float32)
     with torch.no_grad():

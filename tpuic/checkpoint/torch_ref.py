@@ -437,14 +437,94 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7,
     return EfficientNet()
 
 
+# ---------------------------------------------------------------------------
+# ViT (torchvision vision_transformer naming: vit_b_16 / vit_l_16 / vit_s_16)
+# ---------------------------------------------------------------------------
+
+_VIT_CFG = {  # name -> (patch, hidden, depth, heads)
+    "vit-b16": (16, 768, 12, 12),
+    "vit-l16": (16, 1024, 24, 16),
+    "vit-s16": (16, 384, 12, 6),
+    # test-scale (tpuic-only size; same module naming)
+    "vit-tiny": (4, 64, 2, 4),
+}
+
+
+def build_vit(variant: str = "vit-b16", num_classes: int = 7,
+              image_size: int = 224, mlp_head: bool = True):
+    """torchvision ``VisionTransformer``-naming replica: conv_proj,
+    class_token, encoder.pos_embedding, encoder.layers.encoder_layer_i
+    (ln_1 / self_attention / ln_2 / mlp.{0,3}), encoder.ln, heads.head.
+    ``self_attention`` is a real ``nn.MultiheadAttention`` so
+    in_proj_weight/out_proj match upstream checkpoints exactly."""
+    torch, tnn, F = _torch()
+    patch, hidden, depth, heads = _VIT_CFG[variant]
+    n_tokens = (image_size // patch) ** 2 + 1
+
+    class EncoderBlock(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln_1 = tnn.LayerNorm(hidden, eps=1e-6)
+            self.self_attention = tnn.MultiheadAttention(
+                hidden, heads, batch_first=True)
+            self.ln_2 = tnn.LayerNorm(hidden, eps=1e-6)
+            # torchvision MLPBlock state-dict naming (>=0.12): Sequential
+            # indices 0 (Linear), 1 (GELU), 2 (Dropout), 3 (Linear).
+            self.mlp = tnn.Sequential(
+                tnn.Linear(hidden, 4 * hidden), tnn.GELU(),
+                tnn.Dropout(0.0), tnn.Linear(4 * hidden, hidden))
+
+        def forward(self, x):
+            y = self.ln_1(x)
+            y, _ = self.self_attention(y, y, y, need_weights=False)
+            x = x + y
+            return x + self.mlp(self.ln_2(x))
+
+    class Encoder(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pos_embedding = tnn.Parameter(
+                torch.empty(1, n_tokens, hidden).normal_(std=0.02))
+            self.layers = tnn.Sequential()
+            for i in range(depth):
+                self.layers.add_module(f"encoder_layer_{i}", EncoderBlock())
+            self.ln = tnn.LayerNorm(hidden, eps=1e-6)
+
+        def forward(self, x):
+            return self.ln(self.layers(x + self.pos_embedding))
+
+    class VisionTransformer(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv_proj = tnn.Conv2d(3, hidden, patch, patch)
+            self.class_token = tnn.Parameter(torch.zeros(1, 1, hidden))
+            self.encoder = Encoder()
+            self.heads = tnn.Sequential()
+            self.heads.add_module(
+                "head", reference_mlp_head(hidden, num_classes) if mlp_head
+                else tnn.Linear(hidden, num_classes))
+
+        def forward(self, x):
+            b = x.shape[0]
+            x = self.conv_proj(x)                     # [B, D, H', W']
+            x = x.reshape(b, hidden, -1).permute(0, 2, 1)   # [B, N, D]
+            x = torch.cat([self.class_token.expand(b, -1, -1), x], dim=1)
+            x = self.encoder(x)
+            return self.heads(x[:, 0])
+
+    return VisionTransformer()
+
+
 def build_reference_model(arch: str, num_classes: int = 7,
-                          mlp_head: bool = True):
+                          mlp_head: bool = True, image_size: int = 224):
     """Replica of the reference ``Classifier(name, n)`` for a backbone name
     (nn/classifier.py:8-34). arch: resnet18/34/50/101/152, inceptionv3,
-    efficientnet-b{0..7}. ``mlp_head`` selects the reference MLP head vs
-    the family's plain single-Linear head (torchvision fc /
-    efficientnet_pytorch _fc) — pass what _infer_head detected so --verify
-    builds a replica that can actually load the checkpoint."""
+    efficientnet-b{0..7}, vit-{b16,l16,s16,tiny}. ``mlp_head`` selects the
+    reference MLP head vs the family's plain single-Linear head
+    (torchvision fc / efficientnet_pytorch _fc) — pass what _infer_head
+    detected so --verify builds a replica that can actually load the
+    checkpoint. ``image_size`` only matters for ViT (pos-embedding length);
+    CNNs ignore it."""
     if arch in _RESNET_CFG:
         return build_resnet(arch, num_classes, mlp_head=mlp_head)
     if arch.startswith("inception"):
@@ -452,4 +532,7 @@ def build_reference_model(arch: str, num_classes: int = 7,
     if arch.startswith("efficientnet"):
         variant = arch.rsplit("-", 1)[-1] if "-" in arch else "b0"
         return build_efficientnet(variant, num_classes, mlp_head=mlp_head)
+    if arch in _VIT_CFG:
+        return build_vit(arch, num_classes, image_size=image_size,
+                         mlp_head=mlp_head)
     raise ValueError(f"no torch replica for arch '{arch}'")
