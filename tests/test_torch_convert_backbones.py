@@ -329,3 +329,40 @@ def test_export_vit_moe_raises():
                            train=False)
     with pytest.raises(ValueError, match="Switch-MoE"):
         export_state_dict(dict(variables["params"]), {})
+
+
+def test_vit_pos_embed_interpolation_on_size_change(tmp_path):
+    """--init-from a 16px-trained ViT checkpoint into a 32px model: the
+    pos embedding is grid-interpolated instead of shape-skipped, and every
+    other leaf still maps."""
+    import optax
+
+    from tpuic.checkpoint.torch_convert import (init_state_from_torch,
+                                                interpolate_pos_embed)
+    from tpuic.checkpoint.torch_ref import build_vit
+    from tpuic.train.state import create_train_state
+
+    tm = build_vit("vit-tiny", num_classes=3, image_size=16)
+    ckpt = str(tmp_path / "vit16.pt")
+    torch.save({"state_dict": tm.state_dict()}, ckpt)
+    model = create_model("vit-tiny", 3, dtype="float32")
+    state = create_train_state(model, optax.sgd(0.1), jax.random.key(0),
+                               (1, 32, 32, 3))
+    logs = []
+    state = init_state_from_torch(state, ckpt, "vit-tiny",
+                                  log=logs.append)
+    assert any("pos_embed interpolated 17 -> 65" in l for l in logs), logs
+    # every leaf mapped (the interpolation made pos_embed mergeable)
+    assert any("38/38 param" in l for l in logs), logs
+    pe = state.params["backbone"]["pos_embed"]
+    pe = np.asarray(getattr(pe, "value", pe))
+    assert pe.shape == (1, 65, 64)
+    # cls row passes through untouched
+    np.testing.assert_allclose(
+        pe[0, 0], tm.encoder.pos_embedding.detach().numpy()[0, 0],
+        rtol=1e-6)
+    # identity when sizes already agree
+    src = np.arange(17 * 8, dtype=np.float32).reshape(1, 17, 8)
+    np.testing.assert_array_equal(interpolate_pos_embed(src, 17), src)
+    with pytest.raises(ValueError, match="non-square"):
+        interpolate_pos_embed(src, 12)

@@ -621,6 +621,30 @@ def convert_reference_checkpoint(path: str,
     return tree
 
 
+def interpolate_pos_embed(pos: np.ndarray, n_target: int) -> np.ndarray:
+    """Resize a ViT position embedding [1, N, D] to ``n_target`` tokens.
+
+    Standard fine-tune-at-a-new-resolution recipe (the DeiT/ViT papers'
+    bicubic resize, done bilinearly here): the cls row passes through and
+    the patch grid is resized as a 2D image. Both token counts must be
+    cls + a square grid."""
+    import jax
+
+    n_src = pos.shape[1]
+    if n_src == n_target:
+        return pos
+    g_src = int(round((n_src - 1) ** 0.5))
+    g_dst = int(round((n_target - 1) ** 0.5))
+    if g_src * g_src + 1 != n_src or g_dst * g_dst + 1 != n_target:
+        raise ValueError(f"non-square token grids: {n_src} -> {n_target}")
+    cls, grid = pos[:, :1], pos[:, 1:]
+    d = pos.shape[-1]
+    grid = grid.reshape(1, g_src, g_src, d)
+    grid = np.asarray(jax.image.resize(grid, (1, g_dst, g_dst, d),
+                                       method="bilinear"))
+    return np.concatenate([cls, grid.reshape(1, g_dst * g_dst, d)], axis=1)
+
+
 def init_state_from_torch(state, path: str, model_name: str, log=print):
     """Convert a torch checkpoint and leniently merge it into ``state``.
 
@@ -630,12 +654,31 @@ def init_state_from_torch(state, path: str, model_name: str, log=print):
     ``*-s2d`` models a pretrained 7x7 stem kernel is re-indexed to the
     space-to-depth layout (models/resnet.py:s2d_stem_kernel) before the
     merge, since lenient_restore would otherwise shape-skip it silently.
+    For ViT models whose training size differs from the checkpoint's, the
+    position embedding is grid-interpolated (``interpolate_pos_embed``) —
+    the reference trains at 299px while torchvision ViTs ship at 224px,
+    and a shape-skip here would silently leave RANDOM pos embeddings.
     """
     import jax
 
     from tpuic.checkpoint.manager import lenient_restore
 
     tree = convert_reference_checkpoint(path)
+    pe = tree.get("params", {}).get("backbone", {}).get("pos_embed")
+    if model_name.startswith("vit") and pe is not None:
+        live = state.params.get("backbone", {}).get("pos_embed")
+        live = getattr(live, "value", live)
+        shape = getattr(live, "shape", (1, 0, 0))
+        n_target = shape[1]
+        # Hidden dims must already agree — a cross-width checkpoint
+        # (vit-b16 into vit-tiny) is NOT mergeable; interpolating it would
+        # log success while lenient_restore still shape-skips the leaf.
+        if (n_target and n_target != pe.shape[1]
+                and shape[-1] == pe.shape[-1]):
+            tree["params"]["backbone"]["pos_embed"] = interpolate_pos_embed(
+                np.asarray(pe), n_target)
+            log(f"[init] {path}: pos_embed interpolated "
+                f"{pe.shape[1]} -> {n_target} tokens")
     if model_name.endswith("-s2d"):
         from tpuic.models.resnet import s2d_stem_kernel
         conv1 = tree.get("params", {}).get("backbone", {}).get("conv1")
