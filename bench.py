@@ -74,7 +74,7 @@ def _measure(platform: str) -> dict:
     from tpuic.runtime.mesh import make_mesh
     from tpuic.train.optimizer import make_optimizer
     from tpuic.train.state import create_train_state
-    from tpuic.train.step import make_train_step
+    from tpuic.train.step import make_eval_step, make_train_step
 
     t_init = time.perf_counter()
     n_chips = jax.device_count()
@@ -129,6 +129,25 @@ def _measure(platform: str) -> dict:
 
     steps_per_sec = n_steps / dt
     images_per_sec = steps_per_sec * global_batch
+
+    # Companion: inference (eval-step) throughput at the same config — the
+    # reference's val pass is half its loop (train.py:78-97); tpuic.predict
+    # runs this exact step. Guarded: an optional enrichment must never sink
+    # the primary train measurement (same rule as the artifact companions
+    # below).
+    eval_images_per_sec = None
+    try:
+        estep = make_eval_step(ocfg, mcfg, mesh)
+        em = estep(state, batch)
+        float(em["count"])  # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            em = estep(state, batch)
+        float(em["count"])
+        eval_images_per_sec = (n_steps * global_batch
+                               / (time.perf_counter() - t0))
+    except Exception:
+        pass
     peak = _peak_flops(jax.devices()[0]) * n_chips
     mfu = flops_per_step * steps_per_sec / peak
     # Device-time breakdown from the committed round-3 profile artifact
@@ -187,6 +206,9 @@ def _measure(platform: str) -> dict:
             "platform": jax.devices()[0].platform,
             "flops_per_step": flops_per_step,
             "step_time_ms": round(1000 * dt / n_steps, 2),
+            "eval_images_per_sec_per_chip": (
+                round(eval_images_per_sec / n_chips, 2)
+                if eval_images_per_sec else None),
             "backend_init_s": round(init_s, 1),
             "compile_s": round(compile_s, 1),
             "dtype": mcfg.dtype,
