@@ -110,6 +110,17 @@ class TestRingFlashAttention:
         with pytest.raises(ValueError, match="no 'seq' axis"):
             ring_flash_attention(q, q, q, mesh)
 
+    def test_composes_with_head_sharding(self, devices8):
+        """SP x TP: heads sharded over 'model' while the ring runs over
+        'seq' — each shard's flash kernel sees H/tp local heads."""
+        mesh = make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+        b, n, h, d = 2, 12, 4, 8
+        q, k, v = (_rand(i + 60, (b, n, h, d)) for i in range(3))
+        got = ring_flash_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dense(q, k, v)),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_ring_flash_vit_matches_dense_vit(self, devices8):
         from tpuic.models import create_model
 

@@ -366,3 +366,14 @@ def test_vit_pos_embed_interpolation_on_size_change(tmp_path):
     np.testing.assert_array_equal(interpolate_pos_embed(src, 17), src)
     with pytest.raises(ValueError, match="non-square"):
         interpolate_pos_embed(src, 12)
+
+
+def test_detect_vit_patch32():
+    from tpuic.checkpoint.torch_convert import detect_vit_variant
+
+    assert detect_vit_variant(
+        {"conv_proj.weight": np.zeros((768, 3, 32, 32), np.float32)}
+    ) == "vit-b32"
+    assert detect_vit_variant(
+        {"conv_proj.weight": np.zeros((1024, 3, 32, 32), np.float32)}
+    ) == "vit-l32"

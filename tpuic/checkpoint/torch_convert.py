@@ -531,6 +531,7 @@ def detect_vit_variant(state_dict: Mapping[str, Any]) -> str:
         raise ValueError("no conv_proj.weight in state_dict")
     hidden, _, patch, _ = w.shape
     names = {(768, 16): "vit-b16", (1024, 16): "vit-l16",
+             (768, 32): "vit-b32", (1024, 32): "vit-l32",
              (384, 16): "vit-s16", (64, 4): "vit-tiny"}
     name = names.get((int(hidden), int(patch)))
     if name is None:

@@ -444,6 +444,8 @@ def build_efficientnet(variant: str = "b0", num_classes: int = 7,
 _VIT_CFG = {  # name -> (patch, hidden, depth, heads)
     "vit-b16": (16, 768, 12, 12),
     "vit-l16": (16, 1024, 24, 16),
+    "vit-b32": (32, 768, 12, 12),
+    "vit-l32": (32, 1024, 24, 16),
     "vit-s16": (16, 384, 12, 6),
     # test-scale (tpuic-only size; same module naming)
     "vit-tiny": (4, 64, 2, 4),

@@ -123,6 +123,8 @@ def _register_builtins():
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
     register("vit-l16", _vit_factory(_vit.vit_l16))
+    register("vit-b32", _vit_factory(_vit.vit_b32))
+    register("vit-l32", _vit_factory(_vit.vit_l32))
     register("vit-s16", _vit_factory(_vit.vit_s16))
     register("vit-tiny", _vit_factory(_vit.vit_tiny))
     # Switch-MoE variants (models/moe.py): expert-parallel over the mesh
