@@ -150,3 +150,49 @@ def test_detect_resnet152_depth():
     sd23 = {"layer1.0.conv3.weight": 0}
     sd23.update({f"layer3.{i}.conv1.weight": 0 for i in range(23)})
     assert detect_resnet_depth(sd23) == "resnet101"
+
+
+class TestDropPath:
+    """Stochastic depth (ModelConfig.drop_path, DeiT linear ramp)."""
+
+    def _model(self, dp):
+        from tpuic.models import create_model
+        return create_model("vit-tiny", 3, dtype="float32", drop_path=dp)
+
+    def test_zero_rate_is_identity_and_eval_ignores_rate(self):
+        import jax
+        x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+        base = self._model(0.0)
+        v = base.init(jax.random.key(0), x, train=False)
+        a = base.apply(v, x, train=False)
+        # Same params, dp>0: eval forward unchanged (no drop at inference).
+        b = self._model(0.5).apply(v, x, train=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_full_rate_drops_residual_branches(self):
+        """A single EncoderBlock with drop_path=1.0 in train mode is the
+        identity: both residual BRANCHES are always dropped (and the
+        keep=0 rescale must not produce NaN)."""
+        import jax
+        import jax.numpy as jnp
+        from tpuic.models.vit import EncoderBlock
+
+        blk = EncoderBlock(num_heads=2, dtype=jnp.float32, drop_path=1.0)
+        x = jax.random.normal(jax.random.key(2), (2, 5, 8))
+        # EncoderBlock's second arg is DETERMINISTIC (False = train mode).
+        v = blk.init({"params": jax.random.key(0),
+                      "dropout": jax.random.key(1)}, x, False)
+        out = blk.apply(v, x, False, rngs={"dropout": jax.random.key(3)})
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_train_mode_is_rng_deterministic(self):
+        import jax
+        x = jax.random.normal(jax.random.key(1), (4, 16, 16, 3))
+        m = self._model(0.7)
+        v = m.init({"params": jax.random.key(0),
+                    "dropout": jax.random.key(1)}, x, train=False)
+        a = m.apply(v, x, train=True, rngs={"dropout": jax.random.key(5)})
+        b = m.apply(v, x, train=True, rngs={"dropout": jax.random.key(5)})
+        c = m.apply(v, x, train=True, rngs={"dropout": jax.random.key(6)})
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))

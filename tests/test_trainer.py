@@ -175,3 +175,20 @@ def test_trainer_zero1_wiring(tmp_path):
     assert any(s.spec != P() for s in
                jax.tree_util.tree_leaves(trainer.state_sharding.opt_state))
     assert trainer.fit() >= 0.0
+
+
+def test_trainer_rejects_fold_smaller_than_global_batch(imagefolder):
+    """drop_last + a train fold smaller than one global batch would train
+    ZERO steps per epoch while still checkpointing — refuse loudly."""
+    from tpuic.config import Config, DataConfig, ModelConfig, OptimConfig, RunConfig
+    from tpuic.train.loop import Trainer
+
+    cfg = Config(
+        data=DataConfig(data_dir=imagefolder, resize_size=16, batch_size=64,
+                        pack=False),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0),
+        optim=OptimConfig(class_weights=(), milestones=()),
+        run=RunConfig(epochs=1, ckpt_dir="/tmp/never-used"),
+    )
+    with pytest.raises(ValueError, match="ZERO steps"):
+        Trainer(cfg)

@@ -115,6 +115,9 @@ class ModelConfig:
     # (ulysses with its head-sharded local attention run through the flash
     # kernel). CNNs ignore this.
     attention: str = "dense"
+    # Stochastic depth for ViT backbones (rate of the LAST block; rates
+    # ramp linearly from 0 — the DeiT schedule). CNNs ignore this.
+    drop_path: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

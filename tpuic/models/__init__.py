@@ -41,7 +41,8 @@ from tpuic.models.vit import ATTENTION_IMPLS  # noqa: E402,F401
 def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     param_dtype=jnp.float32, bn_momentum: float = 0.9,
                     bn_eps: float = 1e-5, attention: str = "dense",
-                    mesh=None, bn_f32_stats: bool = True):
+                    mesh=None, bn_f32_stats: bool = True,
+                    drop_path: float = 0.0):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     if attention not in ATTENTION_IMPLS:
@@ -51,20 +52,22 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
     return factory(num_classes=num_classes, dtype=dtype,
                    param_dtype=param_dtype, bn_momentum=bn_momentum,
                    bn_eps=bn_eps, attention=attention, mesh=mesh,
-                   bn_f32_stats=bn_f32_stats), has_aux
+                   bn_f32_stats=bn_f32_stats, drop_path=drop_path), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  dtype="bfloat16", param_dtype="float32",
                  bn_momentum: float = 0.9, bn_eps: float = 1e-5,
                  attention: str = "dense", mesh=None,
-                 bn_f32_stats: bool = True) -> Classifier:
+                 bn_f32_stats: bool = True,
+                 drop_path: float = 0.0) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
                                         bn_momentum=bn_momentum, bn_eps=bn_eps,
                                         attention=attention, mesh=mesh,
-                                        bn_f32_stats=bn_f32_stats)
+                                        bn_f32_stats=bn_f32_stats,
+                                        drop_path=drop_path)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
@@ -75,14 +78,15 @@ def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                         bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
                         attention=cfg.attention, mesh=mesh,
-                        bn_f32_stats=cfg.bn_f32_stats)
+                        bn_f32_stats=cfg.bn_f32_stats,
+                        drop_path=cfg.drop_path)
 
 
 def _register_builtins():
     def _rn(factory, **extra):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats):
-            del num_classes, attention, mesh
+                 attention, mesh, bn_f32_stats, drop_path):
+            del num_classes, attention, mesh, drop_path
             return factory(dtype=dtype, param_dtype=param_dtype,
                            bn_momentum=bn_momentum, bn_eps=bn_eps,
                            bn_f32_stats=bn_f32_stats, **extra)
@@ -101,10 +105,10 @@ def _register_builtins():
 
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats):
+                 attention, mesh, bn_f32_stats, drop_path):
             # torch effnet: eps 1e-3; f32 stats kept (experiment is
             # ResNet-scoped, ModelConfig.bn_f32_stats).
-            del num_classes, bn_eps, attention, mesh, bn_f32_stats
+            del num_classes, bn_eps, attention, mesh, bn_f32_stats, drop_path
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -115,10 +119,10 @@ def _register_builtins():
 
     def _vit_factory(ctor):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats):
+                 attention, mesh, bn_f32_stats, drop_path):
             del num_classes, bn_momentum, bn_eps, bn_f32_stats  # no BN in ViT
             return ctor(dtype=dtype, param_dtype=param_dtype,
-                        attention=attention, mesh=mesh)
+                        attention=attention, mesh=mesh, drop_path=drop_path)
         return make
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
@@ -133,9 +137,9 @@ def _register_builtins():
     register("vit-tiny-moe", _vit_factory(_vit.vit_tiny_moe))
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-             attention, mesh, bn_f32_stats):
+             attention, mesh, bn_f32_stats, drop_path):
         # torch inception: eps 1e-3 (module default); f32 stats kept.
-        del bn_eps, attention, mesh, bn_f32_stats
+        del bn_eps, attention, mesh, bn_f32_stats, drop_path
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

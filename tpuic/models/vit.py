@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
@@ -116,6 +117,23 @@ class EncoderBlock(nn.Module):
     # >0 replaces this block's dense MLP with a Switch MoE of that many
     # experts (models/moe.py) — expert-parallel over the mesh 'model' axis.
     moe_experts: int = 0
+    # Stochastic depth (Huang et al., 2016; standard in ViT recipes): in
+    # train mode each residual BRANCH is dropped per-sample with this
+    # probability and survivors are rescaled by 1/keep. The [B,1,1] mask
+    # broadcasts — one bernoulli per sample, not per activation — so the
+    # op fuses into the residual add (no extra HBM pass).
+    drop_path: float = 0.0
+
+    def _residual(self, x: jnp.ndarray, y: jnp.ndarray,
+                  deterministic: bool) -> jnp.ndarray:
+        if deterministic or self.drop_path == 0.0:
+            return x + y
+        keep = 1.0 - self.drop_path
+        mask = jax.random.bernoulli(self.make_rng("dropout"), keep,
+                                    (y.shape[0], 1, 1))
+        # max() guards the degenerate rate 1.0 (keep=0 -> 0/0 = NaN; the
+        # mask is all-False there, so the scale value is never used).
+        return x + y * (mask.astype(y.dtype) / max(keep, 1e-6))
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -127,7 +145,7 @@ class EncoderBlock(nn.Module):
                                name="attn")(y, deterministic)
         if self.dropout:
             y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
-        x = x + y
+        x = self._residual(x, y, deterministic)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
         if self.moe_experts:
@@ -143,7 +161,7 @@ class EncoderBlock(nn.Module):
                        ("model", "embed"))(y)
         if self.dropout:
             y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
-        return x + y
+        return self._residual(x, y, deterministic)
 
 
 class ViT(nn.Module):
@@ -163,6 +181,9 @@ class ViT(nn.Module):
     # convention) uses a SwitchMoEMlp with ``moe_experts`` experts.
     moe_experts: int = 0
     moe_every: int = 2
+    # Stochastic-depth rate of the LAST block; per-block rates ramp
+    # linearly from 0 (the standard DeiT schedule).
+    drop_path: float = 0.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -183,9 +204,11 @@ class ViT(nn.Module):
             moe = (self.moe_experts
                    if self.moe_experts
                    and i % self.moe_every == self.moe_every - 1 else 0)
+            dp = (self.drop_path * i / max(1, self.depth - 1)
+                  if self.drop_path else 0.0)
             x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
                              self.dtype, self.param_dtype, self.attention,
-                             self.mesh, moe,
+                             self.mesh, moe, dp,
                              name=f"block{i}")(x, deterministic=not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)

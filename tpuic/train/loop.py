@@ -74,6 +74,17 @@ class Trainer:
                                    seed=d.shuffle_seed, num_workers=d.num_workers,
                                    prefetch=d.prefetch, drop_last=True,
                                    device_cache_bytes=cache_total)
+        if self.train_loader.steps_per_epoch() == 0:
+            # drop_last with a fold smaller than ONE global batch would
+            # otherwise train zero steps per epoch while still writing
+            # checkpoints and reporting val numbers — a silent no-op run.
+            raise ValueError(
+                f"train fold has {len(self.train_ds)} images but the "
+                f"global batch is {global_batch} "
+                f"({d.batch_size}/chip x {n_data} data-parallel devices): "
+                "every epoch would train ZERO steps (the trailing partial "
+                "batch is dropped). Reduce --batchsize or the device "
+                "count, or add data.")
         self.val_loader = Loader(self.val_ds,
                                  d.resolved_val_batch_size() * n_data,
                                  step_mesh, shuffle=False,

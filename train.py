@@ -122,6 +122,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the forward in backward (trade FLOPs "
                         "for activation memory/bandwidth)")
+    p.add_argument("--drop-path", type=float, default=0.0,
+                   help="stochastic-depth rate for ViT backbones (last "
+                        "block; linear DeiT ramp from 0)")
     p.add_argument("--bn-bf16-stats", action="store_true",
                    help="accumulate BatchNorm batch statistics in bf16 "
                         "instead of f32 (ResNet family; HBM-bandwidth "
@@ -153,7 +156,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         pack=not args.no_pack, cache_dir=args.cache_dir),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
-                          remat=args.remat,
+                          remat=args.remat, drop_path=args.drop_path,
                           bn_f32_stats=not args.bn_bf16_stats),
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
