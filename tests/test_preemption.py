@@ -81,7 +81,9 @@ def test_preempted_fit_flushes_and_resume_replays_epoch(tmp_path):
 
 def test_preemption_before_first_epoch_resumes_at_zero(tmp_path):
     root = str(tmp_path / "data0")
-    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=4,
+    # >= one global batch (2/chip x 8 fake devices = 16): the Trainer now
+    # rejects folds that would train zero steps per epoch.
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=8,
                                size=24)
     ckpt = str(tmp_path / "ckpt0")
     trainer = Trainer(_cfg(root, ckpt, epochs=2))

@@ -139,6 +139,10 @@ class OptimConfig:
     # 7-class dataset. Overrides class_weights.
     auto_class_weights: bool = False
     weight_decay: float = 0.0
+    # Mixup (Zhang et al., 2018): Beta(alpha, alpha) convex image/label
+    # mixing, applied on-device inside the jitted train step (one lambda
+    # per step). 0 disables; 0.2 is the common ImageNet setting.
+    mixup_alpha: float = 0.0
     # LARS settings for the large-batch config (BASELINE.md config 5).
     lars_momentum: float = 0.9
     lars_trust_coefficient: float = 0.001

@@ -91,6 +91,28 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         # Per-step dropout/drop-path randomness, deterministic in (seed, step).
         dropout_rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
+        # Mixup (Zhang et al., 2018), fully on-device inside the jitted
+        # step: one Beta(a, a) lambda per step, pairs drawn by a global
+        # batch permutation (on a sharded batch the gather is a GSPMD
+        # collective over ICI — one batch-sized exchange per step). The
+        # loss becomes lam*CE(y) + (1-lam)*CE(y_perm); accuracy is
+        # reported against the ORIGINAL labels (standard practice). The
+        # Trainer's train loader guarantees full batches (drop_last +
+        # the zero-steps guard), so every permuted partner is a real
+        # sample.
+        labels_mix = None
+        lam = None
+        if optim_cfg.mixup_alpha > 0:
+            mix_rng = jax.random.fold_in(dropout_rng, 0x6D69)
+            lam = jax.random.beta(mix_rng, optim_cfg.mixup_alpha,
+                                  optim_cfg.mixup_alpha)
+            perm = jax.random.permutation(jax.random.fold_in(mix_rng, 1),
+                                          images.shape[0])
+            images = (lam * images.astype(jnp.float32)
+                      + (1.0 - lam) * images[perm].astype(jnp.float32)
+                      ).astype(images.dtype)
+            labels_mix = labels[perm]
+
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}
             # 'intermediates' carries sown MoE load-balancing losses
@@ -123,6 +145,13 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                                        label_smoothing=smoothing,
                                        impl="fused" if optim_cfg.fused_loss
                                        else "reference", mesh=mesh)
+            if labels_mix is not None:
+                loss_b = classification_loss(
+                    out, labels_mix, class_weights=class_weights, mask=mask,
+                    aux_weight=aux_w, label_smoothing=smoothing,
+                    impl="fused" if optim_cfg.fused_loss else "reference",
+                    mesh=mesh)
+                loss = lam * loss + (1.0 - lam) * loss_b
             routers = _moe_router_stats(mutated.get("intermediates", {}))
             if routers and model_cfg.moe_aux_weight:
                 from tpuic.models.moe import switch_aux_loss
