@@ -245,3 +245,69 @@ class TestMixup:
             _state(), b8)
         np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]),
                                    rtol=1e-5)
+
+
+class TestCutMix:
+    def _cfg(self, cutmix=1.0, mixup=0.0):
+        return dataclasses.replace(OCFG, cutmix_alpha=cutmix,
+                                   mixup_alpha=mixup)
+
+    def test_identical_batch_is_identity(self):
+        """Identical samples: pasting a box from an identical partner is a
+        no-op, so the cutmix loss equals the plain loss exactly."""
+        b = synthetic_batch(8, 32, 3)
+        one = {k: np.repeat(np.asarray(v)[:1], 8, axis=0) for k, v in b.items()}
+        one["mask"] = np.ones((8,), np.float32)
+        batch = {k: jnp.asarray(v) for k, v in one.items()}
+        _, m0 = make_train_step(OCFG, MCFG, mesh=None, donate=False)(
+            _state(), batch)
+        _, m1 = make_train_step(self._cfg(), MCFG, mesh=None, donate=False)(
+            _state(), batch)
+        np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                                   rtol=1e-6)
+
+    def test_trains_finite_and_step_varying(self):
+        batch = {k: jnp.asarray(v) for k, v in
+                 synthetic_batch(8, 32, 3).items()}
+        step = make_train_step(self._cfg(), MCFG, mesh=None, donate=False)
+        state = _state()
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert len(set(losses)) > 1  # per-step box varies
+
+    def test_both_enabled_chooses_per_step(self):
+        """mixup+cutmix together compile (lax.cond branch) and train."""
+        batch = {k: jnp.asarray(v) for k, v in
+                 synthetic_batch(8, 32, 3).items()}
+        step = make_train_step(self._cfg(cutmix=1.0, mixup=0.2), MCFG,
+                               mesh=None, donate=False)
+        state, m = step(_state(), batch)
+        assert np.isfinite(float(m["loss"]))
+
+
+def test_mixup_padded_rows_fall_back_to_self_partner():
+    """A partial batch (mask zeros) under mixup must equal plain CE for
+    rows whose pair involves padding — the partner defaults to SELF, so
+    the padded-partner rows are unmixed, not trained on garbage."""
+    rng = np.random.default_rng(0)
+    b = synthetic_batch(8, 32, 3)
+    b["mask"] = np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+    # poison padded rows: if they leak into valid rows' mixing, the loss
+    # shifts far away from the all-self reference below.
+    imgs = np.asarray(b["image"]).copy()
+    imgs[4:] = 1e3
+    b["image"] = imgs
+    batch = {k: jnp.asarray(v) for k, v in b.items()}
+    mix = dataclasses.replace(OCFG, mixup_alpha=0.2)
+    _, m = make_train_step(mix, MCFG, mesh=None, donate=False)(
+        _state(), batch)
+    assert np.isfinite(float(m["loss"]))
+    # Reference: identical batch where every VALID row's partner is
+    # itself (the guaranteed fallback when the permutation pairs a valid
+    # row with padding). Can't fix the permutation from outside, so
+    # assert the self-contained property instead: loss is finite and not
+    # dominated by the poisoned magnitude.
+    assert float(m["loss"]) < 1e3

@@ -143,6 +143,11 @@ class OptimConfig:
     # mixing, applied on-device inside the jitted train step (one lambda
     # per step). 0 disables; 0.2 is the common ImageNet setting.
     mixup_alpha: float = 0.0
+    # CutMix (Yun et al., 2019): Beta(alpha, alpha)-sized box from the
+    # permuted partner pasted per step, labels mixed by EXACT kept area.
+    # 0 disables; 1.0 is the paper setting. When both mixup and cutmix
+    # are set, one is chosen per step (50/50, torchvision recipe).
+    cutmix_alpha: float = 0.0
     # LARS settings for the large-batch config (BASELINE.md config 5).
     lars_momentum: float = 0.9
     lars_trust_coefficient: float = 0.001

@@ -91,27 +91,73 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         # Per-step dropout/drop-path randomness, deterministic in (seed, step).
         dropout_rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
-        # Mixup (Zhang et al., 2018), fully on-device inside the jitted
-        # step: one Beta(a, a) lambda per step, pairs drawn by a global
-        # batch permutation (on a sharded batch the gather is a GSPMD
-        # collective over ICI — one batch-sized exchange per step). The
-        # loss becomes lam*CE(y) + (1-lam)*CE(y_perm); accuracy is
-        # reported against the ORIGINAL labels (standard practice). The
-        # Trainer's train loader guarantees full batches (drop_last +
-        # the zero-steps guard), so every permuted partner is a real
-        # sample.
+        # Mixup (Zhang et al., 2018) / CutMix (Yun et al., 2019), fully
+        # on-device inside the jitted step: one lambda (and one box) per
+        # step, pairs drawn by a global batch permutation (on a sharded
+        # batch the gather is a GSPMD collective over ICI — one
+        # batch-sized exchange per step). The loss becomes
+        # lam*CE(y) + (1-lam)*CE(y_perm); accuracy is reported against
+        # the ORIGINAL labels (standard practice). The Trainer's train
+        # loader guarantees full batches (drop_last + the zero-steps
+        # guard); for any other caller, rows whose pair involves a padded
+        # sample fall back to SELF as the partner — self-mixing is the
+        # exact identity, so partial batches degrade to plain CE per row
+        # instead of training on padding garbage. With BOTH enabled, one
+        # is chosen per step (50/50, the torchvision recipe) via
+        # lax.cond, so only the chosen branch executes.
         labels_mix = None
         lam = None
-        if optim_cfg.mixup_alpha > 0:
+        if optim_cfg.mixup_alpha > 0 or optim_cfg.cutmix_alpha > 0:
             mix_rng = jax.random.fold_in(dropout_rng, 0x6D69)
-            lam = jax.random.beta(mix_rng, optim_cfg.mixup_alpha,
-                                  optim_cfg.mixup_alpha)
             perm = jax.random.permutation(jax.random.fold_in(mix_rng, 1),
                                           images.shape[0])
-            images = (lam * images.astype(jnp.float32)
-                      + (1.0 - lam) * images[perm].astype(jnp.float32)
-                      ).astype(images.dtype)
+            partners = images[perm]
             labels_mix = labels[perm]
+            if mask is not None:
+                pair_ok = (mask * mask[perm]) > 0
+                partners = jnp.where(pair_ok[:, None, None, None],
+                                     partners, images)
+                labels_mix = jnp.where(pair_ok, labels_mix, labels)
+
+            def _mixup(imgs, partners):
+                lam = jax.random.beta(mix_rng, optim_cfg.mixup_alpha,
+                                      optim_cfg.mixup_alpha)
+                out = (lam * imgs.astype(jnp.float32)
+                       + (1.0 - lam) * partners.astype(jnp.float32))
+                return out.astype(imgs.dtype), lam
+
+            def _cutmix(imgs, partners):
+                # Static-shape box: bounds are traced scalars compared
+                # against iotas; the adjusted lambda is the EXACT kept
+                # area (clipping at the borders changes it).
+                h, w = imgs.shape[1], imgs.shape[2]
+                lam0 = jax.random.beta(mix_rng, optim_cfg.cutmix_alpha,
+                                       optim_cfg.cutmix_alpha)
+                cut = jnp.sqrt(1.0 - lam0)
+                cy, cx = jax.random.uniform(
+                    jax.random.fold_in(mix_rng, 2), (2,))
+                bh, bw = cut * h, cut * w
+                y0 = jnp.clip(cy * h - bh / 2, 0, h)
+                y1 = jnp.clip(cy * h + bh / 2, 0, h)
+                x0 = jnp.clip(cx * w - bw / 2, 0, w)
+                x1 = jnp.clip(cx * w + bw / 2, 0, w)
+                ys = jnp.arange(h, dtype=jnp.float32)
+                xs = jnp.arange(w, dtype=jnp.float32)
+                box = ((ys[:, None] >= y0) & (ys[:, None] < y1)
+                       & (xs[None, :] >= x0) & (xs[None, :] < x1))
+                out = jnp.where(box[None, :, :, None], partners, imgs)
+                lam = 1.0 - jnp.mean(box.astype(jnp.float32))
+                return out, lam
+
+            if optim_cfg.mixup_alpha > 0 and optim_cfg.cutmix_alpha > 0:
+                use_mix = jax.random.bernoulli(
+                    jax.random.fold_in(mix_rng, 3))
+                images, lam = jax.lax.cond(use_mix, _mixup, _cutmix,
+                                           images, partners)
+            elif optim_cfg.mixup_alpha > 0:
+                images, lam = _mixup(images, partners)
+            else:
+                images, lam = _cutmix(images, partners)
 
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}

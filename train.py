@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixup", type=float, default=0.0, metavar="ALPHA",
                    help="mixup Beta(alpha, alpha) image/label mixing, "
                         "applied on-device in the train step (0 = off)")
+    p.add_argument("--cutmix", type=float, default=0.0, metavar="ALPHA",
+                   help="cutmix box mixing, on-device (0 = off; with "
+                        "--mixup, one is chosen per step 50/50)")
     p.add_argument("--warmup-epochs", type=int, default=0)
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="accumulate gradients over K steps before one "
@@ -167,6 +170,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           auto_class_weights=auto_weights,
                           weight_decay=args.weight_decay,
                           mixup_alpha=args.mixup,
+                          cutmix_alpha=args.cutmix,
                           warmup_epochs=args.warmup_epochs,
                           grad_accum_steps=args.grad_accum_steps,
                           label_smoothing=args.label_smoothing,
