@@ -311,3 +311,28 @@ def test_mixup_padded_rows_fall_back_to_self_partner():
     # assert the self-contained property instead: loss is finite and not
     # dominated by the poisoned magnitude.
     assert float(m["loss"]) < 1e3
+
+
+class TestRandomErase:
+    def test_zero_prob_is_identity_and_trains_when_on(self):
+        batch = {k: jnp.asarray(v) for k, v in
+                 synthetic_batch(8, 32, 3).items()}
+        plain = make_train_step(OCFG, MCFG, mesh=None, donate=False)
+        off = make_train_step(
+            dataclasses.replace(OCFG, random_erase=0.0), MCFG, mesh=None,
+            donate=False)
+        _, m0 = plain(_state(), batch)
+        _, m1 = off(_state(), batch)
+        np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                                   rtol=1e-7)
+        on = make_train_step(
+            dataclasses.replace(OCFG, random_erase=1.0), MCFG, mesh=None,
+            donate=False)
+        state, m2 = on(_state(), batch)
+        assert np.isfinite(float(m2["loss"]))
+        assert float(m2["loss"]) != float(m0["loss"])  # boxes erased
+        # Per-STEP randomness, isolated from learning: the SAME fresh
+        # params at different step counters must see different boxes.
+        s5 = _state().replace(step=jnp.asarray(5, jnp.int32))
+        _, m5 = on(s5, batch)
+        assert float(m5["loss"]) != float(m2["loss"])

@@ -148,6 +148,10 @@ class OptimConfig:
     # 0 disables; 1.0 is the paper setting. When both mixup and cutmix
     # are set, one is chosen per step (50/50, torchvision recipe).
     cutmix_alpha: float = 0.0
+    # Random erasing (Zhong et al., 2020): per-sample probability of
+    # zeroing a random box (2-33% area) on-device in the train step.
+    # 0 disables; 0.25 is the common timm setting.
+    random_erase: float = 0.0
     # LARS settings for the large-batch config (BASELINE.md config 5).
     lars_momentum: float = 0.9
     lars_trust_coefficient: float = 0.001
@@ -177,6 +181,11 @@ class OptimConfig:
             raise ValueError(
                 f"ema_decay must be in [0, 1); got {self.ema_decay} "
                 "(1.0 would freeze the EMA at its random seed forever)")
+        if not 0.0 <= self.random_erase <= 1.0:
+            raise ValueError(
+                f"random_erase is a PROBABILITY in [0, 1]; got "
+                f"{self.random_erase} (mixup/cutmix use alpha-style "
+                "knobs, this one does not)")
 
 
 @dataclasses.dataclass(frozen=True)

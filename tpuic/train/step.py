@@ -159,6 +159,36 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             else:
                 images, lam = _cutmix(images, partners)
 
+        # Random erasing (Zhong et al., 2020), per SAMPLE: with prob p a
+        # random box (area 2-33%, aspect 0.3-3.3) is zeroed — zero IS the
+        # per-channel mean after the pipeline's normalization. Labels are
+        # untouched, so it composes freely with mixup/cutmix above.
+        if optim_cfg.random_erase > 0:
+            er_rng = jax.random.fold_in(dropout_rng, 0x6572)
+            b, h, w = images.shape[0], images.shape[1], images.shape[2]
+            ks = jax.random.split(er_rng, 5)
+            area = jax.random.uniform(ks[0], (b,), minval=0.02, maxval=0.33)
+            log_ar = jax.random.uniform(ks[1], (b,),
+                                        minval=jnp.log(0.3),
+                                        maxval=jnp.log(3.3))
+            ar = jnp.exp(log_ar)
+            bh = jnp.clip(jnp.sqrt(area * h * w * ar), 1, h)   # [B]
+            bw = jnp.clip(jnp.sqrt(area * h * w / ar), 1, w)
+            cy = jax.random.uniform(ks[2], (b,)) * h
+            cx = jax.random.uniform(ks[3], (b,)) * w
+            y0, y1 = jnp.clip(cy - bh / 2, 0, h), jnp.clip(cy + bh / 2, 0, h)
+            x0, x1 = jnp.clip(cx - bw / 2, 0, w), jnp.clip(cx + bw / 2, 0, w)
+            apply = jax.random.bernoulli(ks[4], optim_cfg.random_erase, (b,))
+            ys = jnp.arange(h, dtype=jnp.float32)
+            xs = jnp.arange(w, dtype=jnp.float32)
+            box = ((ys[None, :, None] >= y0[:, None, None])
+                   & (ys[None, :, None] < y1[:, None, None])
+                   & (xs[None, None, :] >= x0[:, None, None])
+                   & (xs[None, None, :] < x1[:, None, None])
+                   & apply[:, None, None])                     # [B,H,W]
+            images = jnp.where(box[..., None], jnp.zeros_like(images),
+                               images)
+
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}
             # 'intermediates' carries sown MoE load-balancing losses

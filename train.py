@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cutmix", type=float, default=0.0, metavar="ALPHA",
                    help="cutmix box mixing, on-device (0 = off; with "
                         "--mixup, one is chosen per step 50/50)")
+    p.add_argument("--random-erase", type=float, default=0.0, metavar="P",
+                   help="per-sample probability of erasing a random box "
+                        "on-device in the train step (0 = off)")
     p.add_argument("--warmup-epochs", type=int, default=0)
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="accumulate gradients over K steps before one "
@@ -171,6 +174,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           weight_decay=args.weight_decay,
                           mixup_alpha=args.mixup,
                           cutmix_alpha=args.cutmix,
+                          random_erase=args.random_erase,
                           warmup_epochs=args.warmup_epochs,
                           grad_accum_steps=args.grad_accum_steps,
                           label_smoothing=args.label_smoothing,
