@@ -1,20 +1,22 @@
-"""REAL multi-process distributed execution (2 JAX processes over Gloo).
+"""REAL multi-process distributed execution (2 and 4 JAX processes, Gloo).
 
 VERDICT r1/r2 scored "process-group init" partial because the multi-host
-path had never executed multi-process. This launches two actual Python
-processes, each owning one CPU device, through the framework's own
-``tpuic.runtime.distributed.initialize`` (the reference analogue:
-``torch.distributed.launch`` spawning ranks + ``init_process_group``,
-train.py:99-106), and asserts:
+path had never executed multi-process. These tests launch N actual Python
+processes (N parametrized over {2, 4}), each owning one CPU device,
+through the framework's own ``tpuic.runtime.distributed.initialize`` (the
+reference analogue: ``torch.distributed.launch`` spawning ranks +
+``init_process_group``, train.py:99-106), and assert:
 
-- the mesh spans both processes' devices;
+- the mesh spans every process's devices;
 - the packed Loader shards by LIVE process_index/process_count and feeds
-  disjoint local shards of the same global batch;
-- the jitted train step's global reductions agree bitwise across
+  disjoint local shards that exactly cover each global batch;
+- the jitted train step's global reductions agree bitwise across all
   processes (loss is the global mean — DDP/SyncBN semantics);
-- the per-sample eval vector comes back identical on both processes (the
+- the per-sample eval vector comes back identical on every process (the
   cross-process all-gather that replaced the reference's pickle gather,
-  ddp_utils.py:16-56).
+  ddp_utils.py:16-56);
+- (sibling test) FSDP-sharded state round-trips through the Orbax
+  multi-process checkpoint path with per-rank shard writes.
 """
 
 import json
@@ -43,11 +45,11 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-pid = int(sys.argv[1])
+pid, nproc = int(sys.argv[1]), int(sys.argv[2])
 from tpuic.runtime import distributed
 info = distributed.initialize(coordinator_address="localhost:{port}",
-                              num_processes=2, process_id=pid)
-assert info.process_count == 2, info
+                              num_processes=nproc, process_id=pid)
+assert info.process_count == nproc, info
 assert info.process_index == pid, info
 
 # Cross-host preemption agreement (runtime/preemption.py): one rank's
@@ -71,7 +73,7 @@ from tpuic.train.state import create_train_state
 from tpuic.train.step import make_eval_step, make_train_step
 
 mesh = make_mesh(MeshConfig())
-assert mesh.size == 2, mesh
+assert mesh.size == nproc, mesh
 root = {root!r}
 cfg = DataConfig(data_dir=root, resize_size=16)
 ds = ImageFolderDataset(root, "train", 16, cfg)
@@ -217,16 +219,17 @@ def tree(tmp_path_factory):
     return root
 
 
-def test_two_process_distributed_train_and_gather(tree):
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multiprocess_distributed_train_and_gather(tree, nproc):
     timeout = float(os.environ.get("TPUIC_MP_TEST_TIMEOUT", "600"))
     port = _free_port()
     src = _WORKER.format(repo=_REPO, port=port, root=tree)
     env = dict(os.environ)
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
-    procs = [subprocess.Popen([sys.executable, "-c", src, str(i)], env=env,
-                              stdout=subprocess.PIPE,
+    procs = [subprocess.Popen([sys.executable, "-c", src, str(i), str(nproc)],
+                              env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)
-             for i in range(2)]
+             for i in range(nproc)]
     results = {}
     logs = {}
     for i, p in enumerate(procs):
@@ -236,21 +239,23 @@ def test_two_process_distributed_train_and_gather(tree):
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 results[i] = json.loads(line[len("RESULT "):])
-    assert set(results) == {0, 1}, logs
-    r0, r1 = results[0], results[1]
-    # Preemption agreement: rank 0's latch propagated to rank 1; no-latch
-    # round stayed False on both.
-    assert r0["agree"] == [True, False] and r1["agree"] == [True, False]
-    # Global-mean loss: bitwise identical on both ranks (the reference
+    assert set(results) == set(range(nproc)), logs
+    ranks = [results[i] for i in range(nproc)]
+    # Preemption agreement: rank 0's latch propagated to every rank; the
+    # no-latch round stayed False everywhere.
+    assert all(r["agree"] == [True, False] for r in ranks)
+    # Global-mean loss: bitwise identical on all ranks (the reference
     # needed an explicit all_reduce for this, train.py:61-63).
-    assert r0["losses"] == r1["losses"]
-    # Disjoint local shards of each global batch.
-    for ids0, ids1 in zip(r0["ids"], r1["ids"]):
-        assert len(ids0) == len(ids1) == 2  # local batch = 4 / 2 processes
-        assert not (set(ids0) & set(ids1))
+    assert all(r["losses"] == ranks[0]["losses"] for r in ranks)
+    # Disjoint local shards of each global batch, covering it exactly.
+    local = 4 // nproc
+    for step_ids in zip(*(r["ids"] for r in ranks)):
+        assert all(len(ids) == local for ids in step_ids)
+        flat = [i for ids in step_ids for i in ids]
+        assert len(set(flat)) == 4
     # Per-sample wrong vector: the full GLOBAL vector on every process.
-    assert r0["wrong"] == r1["wrong"]
-    assert len(r0["wrong"]) == 4
+    assert all(r["wrong"] == ranks[0]["wrong"] for r in ranks)
+    assert len(ranks[0]["wrong"]) == 4
 
 
 @pytest.mark.parametrize("nproc", [2, 4])
