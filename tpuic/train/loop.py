@@ -43,6 +43,15 @@ from tpuic.train.state import create_train_state
 from tpuic.train.step import make_eval_step, make_train_step
 
 
+def _async_copy(tree) -> None:
+    """Start device->host transfers for every array in a metrics dict so the
+    later (deferred) device_get returns from the transfer cache instead of
+    paying a tunnel RTT. Tolerates plain floats (tests with stub steps)."""
+    for h in jax.tree_util.tree_leaves(tree):
+        if hasattr(h, "copy_to_host_async"):
+            h.copy_to_host_async()
+
+
 class Trainer:
     def __init__(self, cfg: Config, mesh=None, log_dir: Optional[str] = None):
         self.cfg = cfg
@@ -209,6 +218,20 @@ class Trainer:
         metrics = None
         log_every = max(1, self.cfg.run.log_every_steps)
         global_batch = self.train_loader.global_batch
+        # One readback per EPOCH for the optimizer step counter: the in-loop
+        # step number is step0 + host steps, so logging never touches
+        # state.step on the hot path (each device_get is a full tunnel RTT).
+        step0 = int(jax.device_get(self.state.step))
+        # Deferred logging: at log point N we SCHEDULE an async device->host
+        # copy of the interval's metrics and DRAIN log point N-1, whose
+        # values the device finished an interval ago — so the drain returns
+        # from the transfer cache instead of stalling dispatch. The loop
+        # still cannot run away from the device: draining point N-1 throttles
+        # the host to at most one interval of run-ahead, which keeps the
+        # measured images/sec honest. (Round-4 chip finding: four blocking
+        # scalar reads per log point cost ~4 RTTs and held Trainer.fit at
+        # 59% of the const-batch bench over the tunneled link.)
+        pending = None  # (host step number, images/sec, metric handles)
         t_log = time.perf_counter()
         from tpuic.runtime.preemption import agree
         preempt_on = self.cfg.run.handle_preemption
@@ -233,29 +256,49 @@ class Trainer:
             self.state, metrics = self.train_step(
                 self.state, {k: batch[k] for k in ("image", "label", "mask")})
             if (step + 1) % log_every == 0:
-                # The ONLY device->host sync in the loop: one scalar readback
-                # per log_every steps (default 50). Reading every step would
-                # block async dispatch and serialize the pipeline
-                # (round-2 finding — bench-grade throughput needs this).
-                loss = float(metrics["loss"])
+                handles = {"loss": metrics["loss"],
+                           "accuracy": metrics["accuracy"]}
+                if "lr" in metrics:
+                    handles["lr"] = metrics["lr"]
+                _async_copy(handles)
                 now = time.perf_counter()
                 imgs_per_sec = log_every * global_batch / max(now - t_log,
                                                               1e-9)
                 t_log = now
-                losses.update(loss, 1)
-                bar.set_description(
-                    f"Epoch: {epoch}; Loss {losses.val:.4f}|({losses.avg:.4f})")
-                self.logger.write(int(jax.device_get(self.state.step)),
-                                  loss=loss,
-                                  accuracy=float(metrics["accuracy"]),
-                                  lr=float(metrics.get("lr", 0.0)),
-                                  images_per_sec=round(imgs_per_sec, 1))
+                if pending is not None:
+                    self._drain_train_log(pending, losses, bar, epoch)
+                pending = (step0 + step + 1, imgs_per_sec, handles)
+                if step + 1 == len(self.train_loader):
+                    # Last step of the epoch: drain NOW, while the bar is
+                    # still open (set_description on a closed bar is a
+                    # no-op), so the final interval's loss is shown. The
+                    # blocking read sits on the epoch boundary, off the
+                    # steady-state path.
+                    self._drain_train_log(pending, losses, bar, epoch)
+                    pending = None
+        if pending is not None:
+            self._drain_train_log(pending, losses, bar, epoch)
         # Epoch-mean loss over all steps, one sync, off the hot path: the
         # running meter only sees logged points (display semantics identical
         # to the reference bar, train.py:67-68).
         if metrics is not None and losses.count == 0:
             losses.update(float(metrics["loss"]), 1)
         return losses.avg
+
+    def _drain_train_log(self, pending, losses: AverageMeter, bar,
+                         epoch: int) -> None:
+        """Read one deferred log interval (a single batched device_get) and
+        emit the bar description + JSONL record for it."""
+        step_num, imgs_per_sec, handles = pending
+        vals = jax.device_get(handles)
+        loss = float(vals["loss"])
+        losses.update(loss, 1)
+        bar.set_description(
+            f"Epoch: {epoch}; Loss {losses.val:.4f}|({losses.avg:.4f})")
+        self.logger.write(step_num, loss=loss,
+                          accuracy=float(vals["accuracy"]),
+                          lr=float(vals.get("lr", 0.0)),
+                          images_per_sec=round(imgs_per_sec, 1))
 
     def val_epoch(self, epoch: int) -> float:
         """Reference val_epoch (train.py:78-97): exact global accuracy ×100,
@@ -265,9 +308,20 @@ class Trainer:
         have_top5 = False
         collect = self.cfg.run.collect_misclassified
         misclassified: list = []
-        for batch in self.val_loader.epoch(epoch):
-            m = self.eval_step(self.state,
-                               {k: batch[k] for k in ("image", "label", "mask")})
+        # Deferred accumulation: per-batch float() reads would serialize
+        # every eval step against the tunnel RTT (the same stall the train
+        # loop's deferred logging avoids), so metric handles are drained a
+        # WINDOW behind dispatch. The window bound matters on the streaming
+        # (non-resident) val path: each not-yet-executed step pins its uint8
+        # batch upload in HBM, so unbounded run-ahead over a long val fold
+        # would stack hundreds of ~20 MB buffers; draining handle i-W after
+        # dispatching i throttles the host to at most W batches in flight.
+        window = max(2, int(self.cfg.data.prefetch))
+        pending: list = []
+
+        def drain(m, indices) -> None:
+            nonlocal correct, correct5, count, loss_num, loss_den, have_top5
+            m = jax.device_get(m)
             correct += float(m["correct"])
             count += float(m["count"])
             loss_num += float(m["loss_num"])
@@ -281,11 +335,20 @@ class Trainer:
                 # the host-replicated global order — so every host can name
                 # every misclassified sample, reference val_epoch's
                 # all_gather capability (train.py:92) without the pickle.
-                wrong = np.asarray(jax.device_get(m["wrong"]))
+                wrong = np.asarray(m["wrong"])
                 ds = self.val_loader.dataset
                 misclassified.extend(
-                    ds.image_id(int(batch.indices[pos]))
+                    ds.image_id(int(indices[pos]))
                     for pos in np.nonzero(wrong > 0.5)[0])
+        for batch in self.val_loader.epoch(epoch):
+            m = self.eval_step(self.state,
+                               {k: batch[k] for k in ("image", "label", "mask")})
+            _async_copy(m)
+            pending.append((m, batch.indices if collect else None))
+            if len(pending) > window:
+                drain(*pending.pop(0))
+        for item in pending:
+            drain(*item)
         if collect:
             self.last_misclassified = misclassified
         score = 100.0 * correct / max(count, 1.0)
