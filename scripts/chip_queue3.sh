@@ -1,0 +1,44 @@
+#!/bin/bash
+# Remainder of the round-4 chip queue after the N=1025 flash hang took the
+# tunnel down mid-chip_queue2 (see PERF_ANALYSIS.md §10). Safe items first;
+# the long-N flash probe (the wedge trigger's family) runs LAST, after the
+# 384-block palette fix, so a repeat can't cost the other rows.
+set -x -o pipefail
+failures=0
+cd /root/repo
+probe() { python -c "
+from tpuic.runtime.axon_guard import tpu_reachable
+import sys; sys.exit(0 if tpu_reachable(150) else 1)"; }
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 1. ViT MFU push at the b64 sweet spot: fused CE, flash, both.
+python scripts/perf_sweep.py --batches 64 --model vit-b16 --fused-loss \
+  --out perf/vit_fusedce.json 2>&1 | tail -3 || failures=$((failures+1))
+python scripts/perf_sweep.py --batches 64 --model vit-b16 --attention flash \
+  --out perf/vit_flash.json 2>&1 | tail -3 || failures=$((failures+1))
+python scripts/perf_sweep.py --batches 64 --model vit-b16 --attention flash --fused-loss \
+  --out perf/vit_flash_fusedce.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 2. SPMD-vs-plain reconciliation row (VERDICT r3 item 6).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --spmd \
+  --out perf/sweep_spmd.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 3. BN bf16-stat accumulation row (VERDICT r3 item 7).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --bn-bf16-stats \
+  --out perf/sweep_bnbf16.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 4. Retry the N=1025 flash point with power-of-two blocks (the hang was the
+#    one 384-block config), then the long-N OOM probe. Each child now gets
+#    SIGTERM+grace on timeout and the driver aborts if the tunnel dies.
+python scripts/long_seq_bench.py --sizes 512 --batch 32 \
+  --out perf/long_seq_512_retry.json 2>&1 | tail -4 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+python scripts/long_seq_bench.py --sizes 768,1024 --batch 16 --remat \
+  --out perf/long_seq_4k.json 2>&1 | tail -6 || failures=$((failures+1))
+
+echo "chip_queue3: $failures item(s) failed"
+exit $failures

@@ -107,35 +107,58 @@ def main():
         return 2
 
     rows = []
-    for size in (int(s) for s in args.sizes.split(",")):
-        for attention in ("dense", "flash"):
+    configs = [(size, attention)
+               for size in (int(s) for s in args.sizes.split(","))
+               for attention in ("dense", "flash")]
+    for size, attention in configs:
+        # Popen + terminate-then-kill rather than subprocess.run: run's
+        # timeout SIGKILLs immediately, and killing a child mid-TPU-RPC
+        # is what wedged the tunnel after the N=1025 hang (the JAX
+        # client never unwinds the stream). SIGTERM first gives it a
+        # grace window to close the backend.
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--batch", str(args.batch)]
+            + (["--remat"] if args.remat else [])
+            + ["--_child", str(size), attention],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=_REPO)
+        try:
+            stdout, stderr = proc.communicate(timeout=900)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            proc.terminate()
             try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--batch", str(args.batch)]
-                    + (["--remat"] if args.remat else [])
-                    + ["--_child", str(size), attention],
-                    capture_output=True, text=True, cwd=_REPO, timeout=900)
+                # communicate, not wait: the pipes must keep draining or a
+                # child with a full stderr buffer blocks in write() and
+                # burns the grace window.
+                proc.communicate(timeout=30)
             except subprocess.TimeoutExpired:
-                row = {"size": size, "attention": attention,
-                       "error": "timed out after 900s"}
-                rows.append(row)
-                print(json.dumps(row), flush=True)
-                continue
-            row = None
-            for line in reversed((proc.stdout or "").strip().splitlines()):
-                try:
-                    row = json.loads(line)
-                    break
-                except (json.JSONDecodeError, ValueError):
-                    continue
-            if row is None:
-                tail = " | ".join(
-                    (proc.stderr or "").strip().splitlines()[-2:])
-                row = {"size": size, "attention": attention,
-                       "error": f"rc={proc.returncode}: {tail[:300]}"}
+                proc.kill()
+                proc.communicate()
+            row = {"size": size, "attention": attention,
+                   "error": "timed out after 900s"}
             rows.append(row)
             print(json.dumps(row), flush=True)
+            if is_tunneled() and not tpu_reachable(120):
+                rows.append({"error": "tunnel dead after timeout; "
+                                      "aborting remaining configs"})
+                print(json.dumps(rows[-1]), flush=True)
+                break
+            continue
+        row = None
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                row = json.loads(line)
+                break
+            except (json.JSONDecodeError, ValueError):
+                continue
+        if row is None:
+            tail = " | ".join((stderr or "").strip().splitlines()[-2:])
+            row = {"size": size, "attention": attention,
+                   "error": f"rc={rc}: {tail[:300]}"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
     out = {"batch": args.batch, "model": "vit-b16", "remat": args.remat,
            "rows": rows}
     with open(args.out, "w") as f:
