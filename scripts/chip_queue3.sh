@@ -20,6 +20,14 @@ python scripts/perf_sweep.py --batches 64 --model vit-b16 --attention flash --fu
   --out perf/vit_flash_fusedce.json 2>&1 | tail -3 || failures=$((failures+1))
 
 probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 1b. Selective attention remat at the batches where dense-ViT MFU FELL
+#     (allocator pressure from the [B,H,N,N] intermediates, §10b): recompute
+#     only those, keep everything else resident.
+python scripts/perf_sweep.py --batches 128,256 --model vit-b16 \
+  --remat --remat-policy attention \
+  --out perf/vit_remat_attn.json 2>&1 | tail -4 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
 # 2. SPMD-vs-plain reconciliation row (VERDICT r3 item 6).
 python scripts/perf_sweep.py --batches 128 --model resnet50 --spmd \
   --out perf/sweep_spmd.json 2>&1 | tail -3 || failures=$((failures+1))

@@ -26,7 +26,8 @@ PEAK_BF16 = 197e12  # TPU v5e
 def measure(per_chip_batch: int, remat: bool, n_steps: int = 30,
             model_name: str = "resnet50", size: int = 224,
             attention: str = "dense", fused_loss: bool = False,
-            spmd: bool = False, bn_f32_stats: bool = True) -> dict:
+            spmd: bool = False, bn_f32_stats: bool = True,
+            remat_policy: str = "dots") -> dict:
     """``spmd=True`` builds a mesh even on one chip and runs the sharded
     step executable — the production path — so its dispatch/compile delta
     vs the unannotated single-chip path is a measured row, not a claim
@@ -38,7 +39,7 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30,
 
     from tpuic.config import MeshConfig, ModelConfig, OptimConfig
     from tpuic.data.synthetic import synthetic_batch
-    from tpuic.models import create_model
+    from tpuic.models import create_model_from_config
     from tpuic.runtime.mesh import data_sharding, make_mesh
     from tpuic.train.optimizer import make_optimizer
     from tpuic.train.state import create_train_state
@@ -47,14 +48,14 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30,
     n_chips = jax.device_count()
     global_batch = per_chip_batch * n_chips
     mcfg = ModelConfig(name=model_name, num_classes=1000, dtype="bfloat16",
-                       remat=remat, attention=attention,
-                       bn_f32_stats=bn_f32_stats)
+                       remat=remat, remat_policy=remat_policy,
+                       attention=attention, bn_f32_stats=bn_f32_stats)
     ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
                       milestones=(), fused_loss=fused_loss)
     mesh = make_mesh(MeshConfig()) if (spmd or n_chips > 1) else None
-    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype,
-                         attention=mcfg.attention, mesh=mesh,
-                         bn_f32_stats=mcfg.bn_f32_stats)
+    # from_config so every model-shaping field (attention, bn stats,
+    # remat_core for remat_policy='attention') flows to the module.
+    model = create_model_from_config(mcfg, mesh=mesh)
     with (mesh if mesh is not None else contextlib.nullcontext()):
         state = create_train_state(model, make_optimizer(ocfg),
                                    jax.random.key(0),
@@ -88,6 +89,7 @@ def measure(per_chip_batch: int, remat: bool, n_steps: int = 30,
         "model": model_name,
         "per_chip_batch": per_chip_batch,
         "remat": remat,
+        "remat_policy": remat_policy if remat else None,
         "size": size,
         "attention": attention,
         "fused_loss": fused_loss,
@@ -128,6 +130,10 @@ def main():
                          "experiment, VERDICT r3 item 7)")
     ap.add_argument("--remat", action="store_true",
                     help="also measure remat=True at each batch size")
+    ap.add_argument("--remat-policy", default="dots",
+                    choices=["dots", "attention"],
+                    help="policy for the remat rows: 'attention' recomputes "
+                         "only the [B,H,N,N] ViT tensors (see ModelConfig)")
     ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
     args = ap.parse_args()
 
@@ -144,9 +150,11 @@ def main():
                 r = measure(b, remat, model_name=args.model, size=args.size,
                             attention=args.attention,
                             fused_loss=args.fused_loss, spmd=args.spmd,
-                            bn_f32_stats=not args.bn_bf16_stats)
+                            bn_f32_stats=not args.bn_bf16_stats,
+                            remat_policy=args.remat_policy)
             except Exception as e:  # OOM at large batch is a data point
                 r = {"model": args.model, "per_chip_batch": b, "remat": remat,
+                     "remat_policy": args.remat_policy if remat else None,
                      "error": f"{type(e).__name__}: {e}"[:300]}
             print(json.dumps(r), flush=True)
             results.append(r)

@@ -148,6 +148,77 @@ def test_remat_step_matches_plain_step():
                                rtol=1e-5)
 
 
+def _vit_state(mcfg, batch=4, size=32):
+    """Build via create_model_from_config so remat_core flows from the
+    config (the production path — Trainer and perf_sweep do the same)."""
+    from tpuic.models import create_model_from_config
+    model = create_model_from_config(mcfg)
+    return create_train_state(model, make_optimizer(OCFG), jax.random.key(0),
+                              (batch, size, size, 3))
+
+
+def test_attention_remat_policy_matches_plain_step():
+    """remat_policy='attention' (ViT remat_core: the logits->softmax->
+    probs@v core under jax.checkpoint) must be identical numerics to the
+    un-remat step."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    sel_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="attention")
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(4, 32, 3).items()}
+    plain = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+    sel = make_train_step(OCFG, sel_cfg, mesh=None, donate=False)
+    _, m1 = plain(_vit_state(mcfg), batch)
+    _, m2 = sel(_vit_state(sel_cfg), batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(m1["grad_norm"]), float(m2["grad_norm"]),
+                               rtol=1e-5)
+
+
+def test_attention_remat_drops_quadratic_residuals_only():
+    """Both halves of the remat_core contract, driven through the
+    PRODUCTION config path (create_model_from_config sets ViT.remat_core):
+    (a) no [B,H,N,N]-sized residual survives to the backward; (b) the
+    linear-sized MLP activations ARE still saved — full remat (what the
+    feature must NOT degenerate into) would drop those too."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    sel_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="attention")
+    x = jnp.asarray(synthetic_batch(4, 32, 3)["image"])
+
+    def residual_sizes(state):
+        def fwd(params, x):
+            return state.apply_fn({"params": params}, x, train=False)
+        _, vjp_fn = jax.vjp(fwd, state.params, x)
+        return [l.size for l in jax.tree_util.tree_leaves(vjp_fn)
+                if hasattr(l, "size")]
+
+    # vit-tiny at 32px, patch 4: N = 65 tokens, 4 heads, hidden 64.
+    quad = 4 * 4 * 65 * 65          # B * heads * N * N
+    mlp_hidden = 4 * 65 * 4 * 64    # B * N * 4*hidden (GELU input)
+    plain = residual_sizes(_vit_state(mcfg))
+    selective = residual_sizes(_vit_state(sel_cfg))
+    assert any(s == quad for s in plain)
+    assert any(s == mlp_hidden for s in plain)
+    assert not any(s == quad for s in selective)
+    assert any(s == mlp_hidden for s in selective)
+
+
+def test_unknown_remat_policy_rejected():
+    with pytest.raises(ValueError, match="remat_policy"):
+        make_train_step(
+            OCFG, dataclasses.replace(MCFG, remat=True, remat_policy="nope"),
+            mesh=None, donate=False)
+
+
+def test_ineffective_attention_remat_warns():
+    """--remat --remat-policy attention on a model/impl with no dense
+    attention core applies NO remat; that must be loud, not a silent OOM."""
+    with pytest.warns(UserWarning, match="no effect"):
+        make_train_step(
+            OCFG,
+            dataclasses.replace(MCFG, remat=True, remat_policy="attention"),
+            mesh=None, donate=False)
+
+
 def test_weighted_ce_in_step_with_class_weights():
     ocfg = dataclasses.replace(OCFG, class_weights=(3.0, 1.0, 5.0))
     state = _state(ocfg=ocfg)

@@ -99,6 +99,18 @@ class ModelConfig:
     # memory-limited. The reference has no equivalent (torch would need
     # torch.utils.checkpoint rewiring).
     remat: bool = False
+    # What remat recomputes (effective only when remat=True):
+    #   'dots'      — whole-forward jax.checkpoint saving only matmul/conv
+    #                 outputs without batch dims; recomputes all
+    #                 activation-sized tensors (the original behavior;
+    #                 measured -15..20% on ResNet-50, PERF_ANALYSIS.md §1).
+    #   'attention' — ViT ``remat_core``: just the logits->softmax->probs@v
+    #                 core runs under jax.checkpoint, so the [B,H,N,N]
+    #                 tensors that erase allocator headroom past b64 (§10b)
+    #                 are never residuals; recompute is one einsum + softmax
+    #                 per layer. No-op for models/impls with no dense
+    #                 attention core (ResNet; flash never materializes it).
+    remat_policy: str = "dots"
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4
     # MoE load-balancing loss weight (Switch Transformer's alpha; only

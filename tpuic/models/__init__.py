@@ -42,7 +42,7 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     param_dtype=jnp.float32, bn_momentum: float = 0.9,
                     bn_eps: float = 1e-5, attention: str = "dense",
                     mesh=None, bn_f32_stats: bool = True,
-                    drop_path: float = 0.0):
+                    drop_path: float = 0.0, remat_core: bool = False):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     if attention not in ATTENTION_IMPLS:
@@ -52,7 +52,8 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
     return factory(num_classes=num_classes, dtype=dtype,
                    param_dtype=param_dtype, bn_momentum=bn_momentum,
                    bn_eps=bn_eps, attention=attention, mesh=mesh,
-                   bn_f32_stats=bn_f32_stats, drop_path=drop_path), has_aux
+                   bn_f32_stats=bn_f32_stats, drop_path=drop_path,
+                   remat_core=remat_core), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
@@ -60,14 +61,16 @@ def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  bn_momentum: float = 0.9, bn_eps: float = 1e-5,
                  attention: str = "dense", mesh=None,
                  bn_f32_stats: bool = True,
-                 drop_path: float = 0.0) -> Classifier:
+                 drop_path: float = 0.0,
+                 remat_core: bool = False) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
                                         bn_momentum=bn_momentum, bn_eps=bn_eps,
                                         attention=attention, mesh=mesh,
                                         bn_f32_stats=bn_f32_stats,
-                                        drop_path=drop_path)
+                                        drop_path=drop_path,
+                                        remat_core=remat_core)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
@@ -79,14 +82,19 @@ def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
                         bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
                         attention=cfg.attention, mesh=mesh,
                         bn_f32_stats=cfg.bn_f32_stats,
-                        drop_path=cfg.drop_path)
+                        drop_path=cfg.drop_path,
+                        # 'attention' selective remat lives in the model
+                        # (ViT remat_core), not a step-level jax.checkpoint
+                        # (train/step.py resolve_remat_policy).
+                        remat_core=(cfg.remat
+                                    and cfg.remat_policy == "attention"))
 
 
 def _register_builtins():
     def _rn(factory, **extra):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats, drop_path):
-            del num_classes, attention, mesh, drop_path
+                 attention, mesh, bn_f32_stats, drop_path, remat_core):
+            del num_classes, attention, mesh, drop_path, remat_core
             return factory(dtype=dtype, param_dtype=param_dtype,
                            bn_momentum=bn_momentum, bn_eps=bn_eps,
                            bn_f32_stats=bn_f32_stats, **extra)
@@ -105,10 +113,11 @@ def _register_builtins():
 
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats, drop_path):
+                 attention, mesh, bn_f32_stats, drop_path, remat_core):
             # torch effnet: eps 1e-3; f32 stats kept (experiment is
             # ResNet-scoped, ModelConfig.bn_f32_stats).
-            del num_classes, bn_eps, attention, mesh, bn_f32_stats, drop_path
+            del (num_classes, bn_eps, attention, mesh, bn_f32_stats,
+                 drop_path, remat_core)
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -119,10 +128,11 @@ def _register_builtins():
 
     def _vit_factory(ctor):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-                 attention, mesh, bn_f32_stats, drop_path):
+                 attention, mesh, bn_f32_stats, drop_path, remat_core):
             del num_classes, bn_momentum, bn_eps, bn_f32_stats  # no BN in ViT
             return ctor(dtype=dtype, param_dtype=param_dtype,
-                        attention=attention, mesh=mesh, drop_path=drop_path)
+                        attention=attention, mesh=mesh, drop_path=drop_path,
+                        remat_core=remat_core)
         return make
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
@@ -137,9 +147,9 @@ def _register_builtins():
     register("vit-tiny-moe", _vit_factory(_vit.vit_tiny_moe))
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
-             attention, mesh, bn_f32_stats, drop_path):
+             attention, mesh, bn_f32_stats, drop_path, remat_core):
         # torch inception: eps 1e-3 (module default); f32 stats kept.
-        del bn_eps, attention, mesh, bn_f32_stats, drop_path
+        del bn_eps, attention, mesh, bn_f32_stats, drop_path, remat_core
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

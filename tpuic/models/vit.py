@@ -56,6 +56,16 @@ class MultiHeadAttention(nn.Module):
     # (shard_map over the 'data' axis) and carries the 'seq' axis for ring
     # attention; None => single-device pallas_call / dense.
     mesh: Any = None
+    # Selective remat (ModelConfig.remat_policy='attention'): wrap the dense
+    # logits->softmax->probs@v core in jax.checkpoint, so the ONLY saved
+    # residuals are q/k/v ([B,N,H,Dh], linear in N) and the backward
+    # recomputes one einsum + softmax per layer. This is done here at the
+    # module level, not with checkpoint_name tags + a names policy in the
+    # train step: softmax's own backward wants its (un-nameable, internal)
+    # output, so a save-anything-except-names policy still saves quadratic
+    # precision-cast copies of it — measured via print_saved_residuals.
+    # No effect on the flash/ring/ulysses paths (no [N,N] tensor to drop).
+    remat_core: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -97,10 +107,19 @@ class MultiHeadAttention(nn.Module):
                 use_flash=self.attention == "ulysses-flash")
         else:
             scale = 1.0 / np.sqrt(head_dim)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            probs = nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(self.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+            def core(q, k, v):
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+                probs = nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(self.dtype)
+                return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+            if self.remat_core:
+                # Drop every [B,H,N,N] intermediate — the tensors that
+                # dominate ViT activation memory past b64
+                # (PERF_ANALYSIS.md §10b). See the remat_core field note.
+                core = jax.checkpoint(core)
+            out = core(q, k, v)
         out = out.reshape(out.shape[0], out.shape[1], d)
         return _dense(d, "out", self.dtype, self.param_dtype,
                       ("model", "embed"))(out)
@@ -123,6 +142,8 @@ class EncoderBlock(nn.Module):
     # broadcasts — one bernoulli per sample, not per activation — so the
     # op fuses into the residual add (no extra HBM pass).
     drop_path: float = 0.0
+    # See MultiHeadAttention.remat_core.
+    remat_core: bool = False
 
     def _residual(self, x: jnp.ndarray, y: jnp.ndarray,
                   deterministic: bool) -> jnp.ndarray:
@@ -142,6 +163,7 @@ class EncoderBlock(nn.Module):
                          name="ln1")(x)
         y = MultiHeadAttention(self.num_heads, self.dtype, self.param_dtype,
                                self.attention, self.mesh,
+                               remat_core=self.remat_core,
                                name="attn")(y, deterministic)
         if self.dropout:
             y = nn.Dropout(self.dropout)(y, deterministic=deterministic)
@@ -184,6 +206,8 @@ class ViT(nn.Module):
     # Stochastic-depth rate of the LAST block; per-block rates ramp
     # linearly from 0 (the standard DeiT schedule).
     drop_path: float = 0.0
+    # See MultiHeadAttention.remat_core.
+    remat_core: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -209,6 +233,7 @@ class ViT(nn.Module):
             x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
                              self.dtype, self.param_dtype, self.attention,
                              self.mesh, moe, dp,
+                             remat_core=self.remat_core,
                              name=f"block{i}")(x, deterministic=not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)

@@ -24,6 +24,7 @@ ONE jitted XLA program over the device mesh:
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -64,6 +65,42 @@ def _replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
 
+def resolve_remat_policy(model_cfg: ModelConfig):
+    """Step-level jax.checkpoint policy for the config, or None.
+
+    'dots': wrap the whole forward, keeping only matmul/conv outputs
+    without batch dims (i.e. nothing activation-sized); the backward
+    recomputes activations instead of round-tripping them through HBM.
+
+    'attention' returns None on purpose: the selective form lives in the
+    MODEL (ViT ``remat_core`` — create_model_from_config sets it from the
+    config), wrapping just the logits->softmax->probs@v core so only
+    q/k/v survive as residuals. It is not expressible as a step-level
+    names policy: softmax's backward wants its own internal output, so a
+    save-anything-except-names policy still saves quadratic copies of it
+    (verified with jax.ad_checkpoint.print_saved_residuals).
+    """
+    if not model_cfg.remat:
+        return None
+    if model_cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if model_cfg.remat_policy == "attention":
+        # remat_core only exists in ViT's dense path; anywhere else this
+        # combination applies NO remat at all — loud beats a silent OOM at
+        # a batch size --remat (dots) would have fit.
+        if "vit" not in model_cfg.name or model_cfg.attention != "dense":
+            warnings.warn(
+                f"remat_policy='attention' has no effect for model="
+                f"'{model_cfg.name}' with attention="
+                f"'{model_cfg.attention}': only the dense ViT attention "
+                "core is rematerializable; NO remat is applied. Use "
+                "remat_policy='dots' for whole-forward remat.",
+                stacklevel=2)
+        return None
+    raise ValueError(f"unknown remat_policy '{model_cfg.remat_policy}'; "
+                     f"available: ['dots', 'attention']")
+
+
 def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                     mesh: Optional[Mesh] = None,
                     lr_schedule: Optional[optax.Schedule] = None,
@@ -83,6 +120,7 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                      if optim_cfg.class_weights else None)
     aux_w = model_cfg.aux_loss_weight
     smoothing = optim_cfg.label_smoothing
+    remat_policy = resolve_remat_policy(model_cfg)
 
     def train_step(state: TrainState, batch):
         images, labels = batch["image"], batch["label"]
@@ -197,13 +235,8 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                                   mutable=["batch_stats", "intermediates"],
                                   rngs={"dropout": rng})
 
-        if model_cfg.remat:
-            # Keep only matmul/conv outputs without batch dims (i.e. nothing
-            # activation-sized); the backward recomputes activations instead
-            # of round-tripping them through HBM.
-            forward = jax.checkpoint(
-                forward,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        if remat_policy is not None:
+            forward = jax.checkpoint(forward, policy=remat_policy)
 
         def loss_fn(params):
             if optim_cfg.freeze_backbone and "backbone" in params:

@@ -131,6 +131,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the forward in backward (trade FLOPs "
                         "for activation memory/bandwidth)")
+    p.add_argument("--remat-policy", default="dots",
+                   choices=["dots", "attention"],
+                   help="what --remat saves: 'dots' recomputes all "
+                        "activation-sized tensors; 'attention' recomputes "
+                        "ONLY the [B,H,N,N] attention logits/probs (ViT)")
     p.add_argument("--drop-path", type=float, default=0.0,
                    help="stochastic-depth rate for ViT backbones (last "
                         "block; linear DeiT ramp from 0)")
@@ -165,7 +170,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         pack=not args.no_pack, cache_dir=args.cache_dir),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
-                          remat=args.remat, drop_path=args.drop_path,
+                          remat=args.remat, remat_policy=args.remat_policy,
+                          drop_path=args.drop_path,
                           bn_f32_stats=not args.bn_bf16_stats),
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
