@@ -66,7 +66,9 @@ def test_extended_flags_map_to_config():
     args = cli.build_parser().parse_args(
         ["--datadir", "/d", "--val-batchsize", "8", "--prefetch", "3",
          "--device-cache-mb", "0", "--log-every-steps", "10",
-         "--label-smoothing", "0.1", "--fused-loss"])
+         "--label-smoothing", "0.1", "--fused-loss",
+         "--clip-grad-norm", "1.0", "--remat", "--remat-policy",
+         "attention"])
     cfg = cli.config_from_args(args)
     assert cfg.data.val_batch_size == 8
     assert cfg.data.prefetch == 3
@@ -74,6 +76,8 @@ def test_extended_flags_map_to_config():
     assert cfg.run.log_every_steps == 10
     assert cfg.optim.label_smoothing == 0.1
     assert cfg.optim.fused_loss
+    assert cfg.optim.grad_clip_norm == 1.0
+    assert cfg.model.remat and cfg.model.remat_policy == "attention"
     # defaults unchanged
     cfg0 = cli.config_from_args(cli.build_parser().parse_args(
         ["--datadir", "/d"]))

@@ -107,3 +107,21 @@ def test_freeze_backbone_masks_updates():
         for a, b in zip(jax.tree_util.tree_leaves(before["head"]),
                         jax.tree_util.tree_leaves(after["head"])))
     assert head_moved
+
+
+def test_grad_clip_norm_bounds_update():
+    """grad_clip_norm caps the global L2 norm BEFORE the lr scaling: a huge
+    gradient produces an update no larger than lr * clip."""
+    import optax
+
+    cfg = dataclasses.replace(OCFG, learning_rate=1.0, grad_clip_norm=1e-3)
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros((4,))}
+    st = tx.init(params)
+    upd, _ = tx.update({"w": jnp.full((4,), 100.0)}, st, params)
+    assert float(optax.global_norm(upd)) <= 1e-3 * 1.01
+    # and off by default: the same gradient passes through at full size
+    tx0 = make_optimizer(dataclasses.replace(OCFG, learning_rate=1.0))
+    upd0, _ = tx0.update({"w": jnp.full((4,), 100.0)},
+                         tx0.init(params), params)
+    assert float(optax.global_norm(upd0)) > 1.0

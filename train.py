@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--milestones", type=int, nargs="*", default=[50, 80])
     p.add_argument("--gamma", type=float, default=0.5)
     p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--clip-grad-norm", type=float, default=0.0,
+                   help="clip gradients to this global L2 norm before the "
+                        "optimizer update (0 = off; standard in ViT/large-"
+                        "batch recipes)")
     p.add_argument("--mixup", type=float, default=0.0, metavar="ALPHA",
                    help="mixup Beta(alpha, alpha) image/label mixing, "
                         "applied on-device in the train step (0 = off)")
@@ -178,6 +182,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           class_weights=weights,
                           auto_class_weights=auto_weights,
                           weight_decay=args.weight_decay,
+                          grad_clip_norm=args.clip_grad_norm,
                           mixup_alpha=args.mixup,
                           cutmix_alpha=args.cutmix,
                           random_erase=args.random_erase,
