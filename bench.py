@@ -265,6 +265,12 @@ def main() -> None:
     for platform in [p.strip() for p in platforms if p.strip()]:
         result, err = _run_child(platform, timeouts.get(platform, 420.0))
         if result is not None:
+            # Trust the child's OWN platform report, not the requested
+            # label: a silent JAX CPU fallback must never be persisted as
+            # chip evidence. Recording runs even if another platform
+            # failed first (the result isn't mutated yet).
+            if result.get("detail", {}).get("platform") == "tpu":
+                _record_tpu_success(result)
             if errors:  # a preferred platform failed first
                 result.setdefault("detail", {})["fallback"] = platform
                 result["error"] = "; ".join(errors)
@@ -280,15 +286,50 @@ def main() -> None:
     print(json.dumps(out), flush=True)
 
 
+_LAST_TPU_PATH = os.path.join(_REPO, "perf", "bench_last_tpu.json")
+
+
+def _record_tpu_success(result: dict) -> None:
+    """Persist a successful live-TPU bench line so a later fallback run can
+    surface THIS bench's own last real measurement, not just the sweep
+    artifact (the tunnel has wedged mid-round twice; the scoreboard must
+    never lose the chip evidence to a flap at round end)."""
+    try:
+        with open(_LAST_TPU_PATH, "w") as f:
+            json.dump({"measured": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime()),
+                       "result": result}, f, indent=2)
+    except OSError:
+        pass
+
+
 def _attach_last_tpu(result: dict) -> None:
     """When the TPU path failed (dev tunnel down — it hung for 8+ hours in
-    round 3), surface the last committed real-chip measurement
-    (perf/sweep.json, scripts/perf_sweep.py) with provenance so the
-    fallback artifact still carries the chip's demonstrated capability.
+    round 3), surface the last committed real-chip measurement with
+    provenance so the fallback artifact still carries the chip's
+    demonstrated capability: this bench's own last successful TPU line
+    (perf/bench_last_tpu.json) when available, else the sweep artifact.
 
     Attached at TOP level, beside value/vs_baseline: a scoreboard reader
     must never see the CPU fallback number without the TPU context next to
     it (VERDICT r3 weak #6 / next-round item 8)."""
+    try:
+        with open(_LAST_TPU_PATH) as f:
+            last = json.load(f)
+        r = last["result"]
+        d = r.get("detail", {})
+        result["last_tpu_measurement"] = {
+            "images_per_sec_per_chip": r["value"],
+            "mfu": d.get("mfu"),
+            "per_chip_batch": (d["global_batch"] // max(1, d.get("n_chips", 1))
+                               if "global_batch" in d else None),
+            "device": d.get("device"),
+            "source": "perf/bench_last_tpu.json (this bench, live TPU)",
+            "measured": last.get("measured"),
+        }
+        return
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
     try:
         path = os.path.join(_REPO, "perf", "sweep.json")
         with open(path) as f:
