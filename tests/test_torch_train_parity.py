@@ -1,0 +1,118 @@
+"""End-to-end TRAINING parity vs torch: same init, same batches, same
+optimizer — the loss trajectories must coincide.
+
+The forward-parity tests (test_torch_convert*.py) pin inference; this pins
+the whole training semantics chain the reference exercises
+(train.py:99-132): train-mode SyncBN batch statistics, weighted CE
+(train.py:48; torch CrossEntropyLoss(weight) normalizes by the sum of
+selected weights — so does tpuic), Adam defaults (torch lr/betas/eps ==
+optax), and the pre-update loss convention (both report loss at the
+params BEFORE the step). The post-training eval check additionally pins
+the BN running-statistics update (momentum 0.9 flax == torch's 0.1
+convention complement).
+
+Torch here is the CPU reference oracle, not a dependency of the
+framework; the model is torch_ref's torchvision-layout replica.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpuic.checkpoint.manager import lenient_restore  # noqa: E402
+from tpuic.checkpoint.torch_convert import convert_resnet  # noqa: E402
+from tpuic.checkpoint.torch_ref import build_resnet  # noqa: E402
+from tpuic.config import ModelConfig, OptimConfig  # noqa: E402
+from tpuic.models import create_model  # noqa: E402
+from tpuic.train.optimizer import make_optimizer  # noqa: E402
+from tpuic.train.state import create_train_state  # noqa: E402
+from tpuic.train.step import make_eval_step, make_train_step  # noqa: E402
+
+LR = 1e-3
+WEIGHTS = (3.0, 1.0, 5.0)
+K_STEPS = 3
+BATCH, SIZE, CLASSES = 4, 48, 3
+
+
+def _batches(k):
+    rng = np.random.default_rng(7)
+    return [
+        (rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32),
+         rng.integers(0, CLASSES, size=BATCH).astype(np.int64))
+        for _ in range(k)
+    ]
+
+
+def test_train_trajectory_matches_torch():
+    torch.manual_seed(3)
+    tmodel = build_resnet("resnet18", num_classes=CLASSES).train()
+    init_sd = {k: v.clone().numpy() for k, v in tmodel.state_dict().items()}
+    opt = torch.optim.Adam(tmodel.parameters(), lr=LR)
+    lossf = torch.nn.CrossEntropyLoss(weight=torch.tensor(WEIGHTS))
+
+    batches = _batches(K_STEPS)
+    torch_losses = []
+    for x, y in batches:
+        opt.zero_grad()
+        out = tmodel(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        loss = lossf(out, torch.from_numpy(y))
+        loss.backward()
+        opt.step()
+        torch_losses.append(loss.item())
+
+    # same init via the converter (captured BEFORE the torch loop
+    # mutated the model in place)
+    tree = convert_resnet(init_sd)
+    mcfg = ModelConfig(name="resnet18", num_classes=CLASSES, dtype="float32")
+    ocfg = OptimConfig(optimizer="adam", learning_rate=LR,
+                       class_weights=WEIGHTS, milestones=())
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (BATCH, SIZE, SIZE, 3))
+    merged_p, n, total = lenient_restore(dict(state.params), tree["params"])
+    assert n == total, f"init transfer incomplete: {n}/{total}"
+    merged_s, ns, ns_total = lenient_restore(dict(state.batch_stats),
+                                             tree["batch_stats"])
+    assert ns == ns_total
+    state = state.replace(params=merged_p, batch_stats=merged_s)
+
+    step = make_train_step(ocfg, mcfg, mesh=None, donate=False)
+    jax_losses = []
+    for x, y in batches:
+        state, metrics = step(state, {"image": jnp.asarray(x),
+                                      "label": jnp.asarray(y)})
+        jax_losses.append(float(metrics["loss"]))
+
+    # Step 0 is pure forward parity (tight); later steps compound the
+    # float-order differences of two independent Adam implementations.
+    np.testing.assert_allclose(jax_losses[0], torch_losses[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(jax_losses, torch_losses,
+                               rtol=5e-3, atol=5e-4)
+
+    # After K steps the models must still agree in EVAL mode: pins the BN
+    # running-statistics update (momentum convention, variance handling),
+    # which train-mode losses never exercise.
+    xe = _batches(1)[0][0]
+    tmodel.eval()
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(
+            np.transpose(xe, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(xe), train=False))
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+    # and the eval STEP's weighted loss agrees with torch's on that batch
+    estep = make_eval_step(ocfg, mcfg, mesh=None)
+    ye = _batches(1)[0][1]
+    tmodel.eval()
+    with torch.no_grad():
+        tl = float(lossf(torch.from_numpy(want), torch.from_numpy(ye)))
+    em = estep(state, {"image": jnp.asarray(xe), "label": jnp.asarray(ye)})
+    np.testing.assert_allclose(float(em["loss_num"] / em["loss_den"]), tl,
+                               rtol=5e-3)
