@@ -38,10 +38,10 @@ K_STEPS = 3
 BATCH, SIZE, CLASSES = 4, 48, 3
 
 
-def _batches(k):
-    rng = np.random.default_rng(7)
+def _batches(k, size=SIZE, seed=7):
+    rng = np.random.default_rng(seed)
     return [
-        (rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32),
+        (rng.normal(size=(BATCH, size, size, 3)).astype(np.float32),
          rng.integers(0, CLASSES, size=BATCH).astype(np.int64))
         for _ in range(k)
     ]
@@ -116,3 +116,53 @@ def test_train_trajectory_matches_torch():
     em = estep(state, {"image": jnp.asarray(xe), "label": jnp.asarray(ye)})
     np.testing.assert_allclose(float(em["loss_num"] / em["loss_den"]), tl,
                                rtol=5e-3)
+
+
+def test_vit_train_trajectory_matches_torch():
+    """Same contract for the attention family: converted ViT init, same
+    batches, Adam — trajectories coincide. Pins MultiheadAttention vs the
+    fused qkv kernel, pre-LN blocks, EXACT (erf) GELU, and softmax in the
+    backward as well as the forward."""
+    from tpuic.checkpoint.torch_convert import convert_vit
+    from tpuic.checkpoint.torch_ref import build_vit
+
+    size = 16  # vit-tiny patch 4 -> 17 tokens; cheap on CPU
+    torch.manual_seed(5)
+    tmodel = build_vit("vit-tiny", num_classes=CLASSES,
+                       image_size=size).train()
+    init_sd = {k: v.clone().numpy() for k, v in tmodel.state_dict().items()}
+    opt = torch.optim.Adam(tmodel.parameters(), lr=LR)
+    lossf = torch.nn.CrossEntropyLoss(weight=torch.tensor(WEIGHTS))
+
+    batches = _batches(K_STEPS, size=size, seed=11)
+    torch_losses = []
+    for x, y in batches:
+        opt.zero_grad()
+        loss = lossf(tmodel(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))),
+                     torch.from_numpy(y))
+        loss.backward()
+        opt.step()
+        torch_losses.append(loss.item())
+
+    tree = convert_vit(init_sd)
+    mcfg = ModelConfig(name="vit-tiny", num_classes=CLASSES, dtype="float32")
+    ocfg = OptimConfig(optimizer="adam", learning_rate=LR,
+                       class_weights=WEIGHTS, milestones=())
+    model = create_model(mcfg.name, mcfg.num_classes, dtype="float32")
+    state = create_train_state(model, make_optimizer(ocfg),
+                               jax.random.key(0), (BATCH, size, size, 3))
+    merged_p, n, total = lenient_restore(dict(state.params), tree["params"])
+    assert n == total, f"init transfer incomplete: {n}/{total}"
+    state = state.replace(params=merged_p)
+
+    step = make_train_step(ocfg, mcfg, mesh=None, donate=False)
+    jax_losses = []
+    for x, y in batches:
+        state, metrics = step(state, {"image": jnp.asarray(x),
+                                      "label": jnp.asarray(y)})
+        jax_losses.append(float(metrics["loss"]))
+
+    np.testing.assert_allclose(jax_losses[0], torch_losses[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(jax_losses, torch_losses,
+                               rtol=5e-3, atol=5e-4)

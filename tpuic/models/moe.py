@@ -121,7 +121,8 @@ class SwitchMoEMlp(nn.Module):
         expert_in = jnp.einsum("bnec,bnd->becd", disp.astype(dt),
                                x.astype(dt))
         h = jnp.einsum("becd,edh->bech", expert_in, w1.astype(dt))
-        h = nn.gelu(h + b1.astype(dt)[None, :, None, :])
+        # exact GELU to match the dense MLP path (vit.py) and torch.
+        h = nn.gelu(h + b1.astype(dt)[None, :, None, :], approximate=False)
         out = jnp.einsum("bech,ehd->becd", h, w2.astype(dt))
         out = out + b2.astype(dt)[None, :, None, :]
 

@@ -178,7 +178,12 @@ class EncoderBlock(nn.Module):
         else:
             y = _dense(d * self.mlp_ratio, "mlp_up", self.dtype,
                        self.param_dtype, ("embed", "model"))(y)
-            y = nn.gelu(y)
+            # approximate=False: torchvision ViT uses EXACT (erf) GELU;
+            # flax's default tanh approximation differs by ~5e-4 per
+            # activation, which compounds across 12 blocks in converted-
+            # checkpoint parity. Elementwise either way — XLA fuses it
+            # into the adjacent matmul, no TPU cost.
+            y = nn.gelu(y, approximate=False)
             y = _dense(d, "mlp_down", self.dtype, self.param_dtype,
                        ("model", "embed"))(y)
         if self.dropout:
