@@ -68,7 +68,7 @@ def test_extended_flags_map_to_config():
          "--device-cache-mb", "0", "--log-every-steps", "10",
          "--label-smoothing", "0.1", "--fused-loss",
          "--clip-grad-norm", "1.0", "--remat", "--remat-policy",
-         "attention"])
+         "attention", "--per-class-metrics"])
     cfg = cli.config_from_args(args)
     assert cfg.data.val_batch_size == 8
     assert cfg.data.prefetch == 3
@@ -78,6 +78,7 @@ def test_extended_flags_map_to_config():
     assert cfg.optim.fused_loss
     assert cfg.optim.grad_clip_norm == 1.0
     assert cfg.model.remat and cfg.model.remat_policy == "attention"
+    assert cfg.run.per_class_metrics
     # defaults unchanged
     cfg0 = cli.config_from_args(cli.build_parser().parse_args(
         ["--datadir", "/d"]))

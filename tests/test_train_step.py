@@ -134,6 +134,37 @@ def test_eval_step_per_sample_wrong_vector_is_global(devices8):
     np.testing.assert_allclose(np.asarray(single["wrong"]), wrong)
 
 
+def test_eval_step_confusion_matrix_exact(devices8):
+    """per_class=True: the [C,C] one-hot contraction must equal the numpy
+    confusion matrix over VALID samples only, and its marginals must agree
+    with the step's own correct/count sums — on the 8-device mesh, where
+    the contraction is a GSPMD-reduced matmul like every other eval sum."""
+    mesh = make_mesh(MeshConfig(), devices8)
+    state = _state()
+    estep = make_eval_step(OCFG, MCFG, mesh=mesh, per_class=True)
+    batch = synthetic_batch(16, 32, 3)
+    batch["mask"] = np.array([1.0] * 13 + [0.0] * 3, np.float32)
+    m = estep(state, batch)
+    conf = np.asarray(m["confusion"])
+    assert conf.shape == (3, 3)
+
+    logits = _state().apply_fn(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(batch["image"]), train=False)
+    preds = np.argmax(np.asarray(logits), axis=-1)
+    want = np.zeros((3, 3))
+    for t, p, valid in zip(batch["label"], preds, batch["mask"]):
+        want[int(t), int(p)] += valid
+    np.testing.assert_allclose(conf, want)
+    assert float(conf.sum()) == float(m["count"]) == 13.0
+    np.testing.assert_allclose(np.trace(conf), float(m["correct"]))
+
+    # single-device path agrees
+    single = make_eval_step(OCFG, MCFG, mesh=None, per_class=True)(
+        _state(), {k: jnp.asarray(v) for k, v in batch.items()})
+    np.testing.assert_allclose(np.asarray(single["confusion"]), conf)
+
+
 def test_remat_step_matches_plain_step():
     """remat must change memory behavior, never numerics."""
     state = _state()

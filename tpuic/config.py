@@ -228,6 +228,13 @@ class RunConfig:
     # reference's pickle all_gather of ragged per-sample data
     # (ddp_utils.py:16-56).
     collect_misclassified: bool = False
+    # Per-class validation metrics: the eval step adds a fixed-shape [C,C]
+    # confusion contraction (true x predicted counts, GSPMD-reduced like
+    # every other eval sum); val_epoch logs exact global per-class accuracy
+    # and saves the summed confusion matrix beside the metrics JSONL.
+    # The aggregate view of the reference's misclassified-image analysis
+    # (train.py:88-92).
+    per_class_metrics: bool = False
     # Profiler trace dir ('' disables). The reference has no profiling at all
     # (SURVEY.md §5); jax.profiler makes it nearly free so it is first-class.
     profile_dir: str = ""

@@ -33,11 +33,15 @@ class MetricLogger:
     def __init__(self, log_dir: Optional[str] = None) -> None:
         self._fh = None
         self._tb = None
-        if log_dir and is_host0():
-            os.makedirs(log_dir, exist_ok=True)
-            self._fh = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        # The active log dir on host 0 (None when logging is off): callers
+        # park non-scalar sidecars (confusion matrices, per-class detail)
+        # beside metrics.jsonl through this.
+        self.root = log_dir if (log_dir and is_host0()) else None
+        if self.root is not None:
+            os.makedirs(self.root, exist_ok=True)
+            self._fh = open(os.path.join(self.root, "metrics.jsonl"), "a")
             from tpuic.metrics.tensorboard import TensorBoardWriter
-            self._tb = TensorBoardWriter(log_dir)
+            self._tb = TensorBoardWriter(self.root)
 
     def write(self, step: int, **scalars) -> None:
         if self._fh is None:

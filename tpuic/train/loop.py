@@ -22,6 +22,7 @@ computes it over the global batch, so no extra logging collective exists.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Optional
@@ -160,7 +161,8 @@ class Trainer:
                                           state_sharding=self.state_sharding)
         self.eval_step = make_eval_step(
             cfg.optim, mcfg, step_mesh, state_sharding=self.state_sharding,
-            per_sample=cfg.run.collect_misclassified)
+            per_sample=cfg.run.collect_misclassified,
+            per_class=cfg.run.per_class_metrics)
         self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
@@ -168,7 +170,6 @@ class Trainer:
             # Reproducibility sidecar: the resolved config (incl. inferred
             # num_classes / derived class weights) next to the checkpoint
             # tracks. tpuic.predict reads it to auto-resolve the model.
-            import json
             resolved = dataclasses.replace(cfg, model=mcfg)
             with open(os.path.join(self.ckpt.root, "config.json"), "w") as f:
                 json.dump(dataclasses.asdict(resolved), f, indent=2,
@@ -307,6 +308,8 @@ class Trainer:
         correct = correct5 = count = loss_num = loss_den = 0.0
         have_top5 = False
         collect = self.cfg.run.collect_misclassified
+        per_class = self.cfg.run.per_class_metrics
+        confusion = None
         misclassified: list = []
         # Deferred accumulation: per-batch float() reads would serialize
         # every eval step against the tunnel RTT (the same stall the train
@@ -321,6 +324,7 @@ class Trainer:
 
         def drain(m, indices) -> None:
             nonlocal correct, correct5, count, loss_num, loss_den, have_top5
+            nonlocal confusion
             m = jax.device_get(m)
             correct += float(m["correct"])
             count += float(m["count"])
@@ -340,6 +344,9 @@ class Trainer:
                 misclassified.extend(
                     ds.image_id(int(indices[pos]))
                     for pos in np.nonzero(wrong > 0.5)[0])
+            if per_class:
+                c = np.asarray(m["confusion"], np.float64)
+                confusion = c if confusion is None else confusion + c
         for batch in self.val_loader.epoch(epoch):
             m = self.eval_step(self.state,
                                {k: batch[k] for k in ("image", "label", "mask")})
@@ -358,6 +365,31 @@ class Trainer:
         if have_top5:
             extra["val_top5"] = 100.0 * correct5 / max(count, 1.0)
             top5_msg = f"; Top-5 {extra['val_top5']:.4f}"
+        if per_class and confusion is not None:
+            # Exact global per-class accuracy: diagonal / true-class counts.
+            # Scalars (balanced = mean per-class recall, and the worst
+            # class) ride the normal logger; the full vector + confusion
+            # matrix are non-scalar, so they go to sidecar files beside
+            # metrics.jsonl.
+            support = confusion.sum(axis=1)
+            cls_acc = np.divide(np.diag(confusion), support,
+                                out=np.zeros_like(support),
+                                where=support > 0)
+            present = support > 0
+            if present.any():
+                extra["val_balanced_acc"] = 100.0 * cls_acc[present].mean()
+                extra["val_worst_class_acc"] = 100.0 * cls_acc[present].min()
+            if self.logger.root is not None:
+                # Per-epoch file: the off-diagonal structure at (say) the
+                # best-checkpoint epoch must survive later epochs.
+                np.save(os.path.join(self.logger.root,
+                                     f"confusion_e{epoch}.npy"), confusion)
+                with open(os.path.join(self.logger.root,
+                                       "per_class.jsonl"), "a") as f:
+                    f.write(json.dumps({
+                        "epoch": epoch,
+                        "acc": [round(100.0 * a, 2) for a in cls_acc],
+                        "support": [int(s) for s in support]}) + "\n")
         host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}{top5_msg}; "
                     f"Val Loss {val_loss:.4f}")
         self.logger.write(int(jax.device_get(self.state.step)),

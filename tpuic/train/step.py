@@ -314,7 +314,8 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
 
 def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                    mesh: Optional[Mesh] = None, state_sharding=None,
-                   per_sample: bool = False) -> Callable:
+                   per_sample: bool = False,
+                   per_class: bool = False) -> Callable:
     """Returns jitted ``eval_step(state, batch) -> metrics``.
 
     metrics: {'correct': Σ 0/1 over valid, 'count': Σ mask,
@@ -333,6 +334,13 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     host ends up with the full global vector and can map positions back to
     image ids through the (host-replicated) epoch order
     (tpuic.data.Loader attaches ``batch.indices``).
+
+    per_class=True adds ``confusion``: the [C, C] count matrix
+    (rows = true class, cols = predicted), computed as a one-hot
+    contraction over the batch dim — a fixed-shape matmul GSPMD reduces
+    over the ``data`` axis like every other eval sum (no ragged
+    per-class gathers). Summed across batches it yields exact global
+    per-class accuracy (diagonal / row sums).
     """
     class_weights = (jnp.asarray(optim_cfg.class_weights, jnp.float32)
                      if optim_cfg.class_weights else None)
@@ -364,6 +372,13 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             out["correct5"] = jnp.sum(topk_accuracy(logits, labels, 5) * m)
         if per_sample:
             out["wrong"] = (1.0 - acc) * m
+        if per_class:
+            n_cls = logits.shape[-1]
+            oh_true = jax.nn.one_hot(labels, n_cls,
+                                     dtype=jnp.float32) * m[:, None]
+            oh_pred = jax.nn.one_hot(jnp.argmax(logits, axis=-1), n_cls,
+                                     dtype=jnp.float32)
+            out["confusion"] = jnp.einsum("bt,bp->tp", oh_true, oh_pred)
         return out
 
     if mesh is None:

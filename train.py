@@ -111,6 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--collect-misclassified", action="store_true",
                    help="gather misclassified val image ids each epoch "
                         "(the reference's per-sample all_gather capability)")
+    p.add_argument("--per-class-metrics", action="store_true",
+                   help="log exact global per-class val accuracy and save "
+                        "the [C,C] confusion matrix beside metrics.jsonl")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--seed", type=int, default=0)
@@ -197,6 +200,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       init_from=args.init_from,
                       log_every_steps=args.log_every_steps,
                       collect_misclassified=args.collect_misclassified,
+                      per_class_metrics=args.per_class_metrics,
                       profile_dir=args.profile_dir, seed=args.seed),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
