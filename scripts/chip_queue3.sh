@@ -28,6 +28,12 @@ python scripts/perf_sweep.py --batches 128,256 --model vit-b16 \
   --out perf/vit_remat_attn.json 2>&1 | tail -4 || failures=$((failures+1))
 
 probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
+# 1c. ViT-B/16 b64 per-op profile: where the 0.537 -> 0.70 MFU gap lives
+#     (attention bytes vs matmul shape vs something else).
+python scripts/perf_profile.py --model vit-b16 --batch 64 \
+  --trace-dir perf/vit_trace --out perf/vit_profile.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3: tunnel down ($failures failures so far)"; exit $((90 + failures)); }
 # 2. SPMD-vs-plain reconciliation row (VERDICT r3 item 6).
 python scripts/perf_sweep.py --batches 128 --model resnet50 --spmd \
   --out perf/sweep_spmd.json 2>&1 | tail -3 || failures=$((failures+1))
