@@ -1,0 +1,47 @@
+#!/bin/bash
+# Remainder of chip_queue3 after the first three ViT rows (fusedce, flash,
+# flash+fusedce — the last of which may still be running as an orphan when
+# this starts: we wait for it). Run detached:
+#   setsid nohup bash scripts/chip_queue3b.sh > perf/chip_queue3b.log 2>&1 &
+set -x -o pipefail
+failures=0
+cd /root/repo
+probe() { python -c "
+from tpuic.runtime.axon_guard import tpu_reachable
+import sys; sys.exit(0 if tpu_reachable(150) else 1)"; }
+
+# Wait for any in-flight perf_sweep orphan from the first queue segment.
+while pgrep -f "perf_sweep.py" > /dev/null; do sleep 20; done
+
+probe || { echo "chip_queue3b: tunnel down"; exit 90; }
+# 1b. Selective attention remat at the batches where dense-ViT MFU FELL.
+python scripts/perf_sweep.py --batches 128,256 --model vit-b16 \
+  --remat --remat-policy attention \
+  --out perf/vit_remat_attn.json 2>&1 | tail -4 || failures=$((failures+1))
+
+probe || { echo "chip_queue3b: tunnel down ($failures)"; exit $((90 + failures)); }
+# 1c. ViT-B/16 b64 per-op profile.
+python scripts/perf_profile.py --model vit-b16 --batch 64 \
+  --trace-dir perf/vit_trace --out perf/vit_profile.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3b: tunnel down ($failures)"; exit $((90 + failures)); }
+# 2. SPMD-vs-plain reconciliation row (VERDICT r3 item 6).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --spmd \
+  --out perf/sweep_spmd.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3b: tunnel down ($failures)"; exit $((90 + failures)); }
+# 3. BN bf16-stat accumulation row (VERDICT r3 item 7).
+python scripts/perf_sweep.py --batches 128 --model resnet50 --bn-bf16-stats \
+  --out perf/sweep_bnbf16.json 2>&1 | tail -3 || failures=$((failures+1))
+
+probe || { echo "chip_queue3b: tunnel down ($failures)"; exit $((90 + failures)); }
+# 4. N=512 flash retry with power-of-two blocks, then the long-N probe.
+python scripts/long_seq_bench.py --sizes 512 --batch 32 \
+  --out perf/long_seq_512_retry.json 2>&1 | tail -4 || failures=$((failures+1))
+
+probe || { echo "chip_queue3b: tunnel down ($failures)"; exit $((90 + failures)); }
+python scripts/long_seq_bench.py --sizes 768,1024 --batch 16 --remat \
+  --out perf/long_seq_4k.json 2>&1 | tail -6 || failures=$((failures+1))
+
+echo "chip_queue3b: $failures item(s) failed"
+exit $failures

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 queue #4: work stranded by the third tunnel flap (~11:45 UTC).
+#   1. True blocks-remat N=4097 rows: the code-review found long_seq_bench
+#      built its model via create_model(), so the earlier
+#      long_seq_4k_blocks.json rows measured NO model-level remat (XLA
+#      auto-remat carried flash; artifact preserved as
+#      perf/long_seq_4k_autoremat.json). Re-measure with the fixed bench.
+#   2. Fresh live-TPU bench line (refreshes perf/bench_last_tpu.json).
+# Run via: nohup bash scripts/chip_poller.sh scripts/chip_queue4.sh &
+set -x -o pipefail
+failures=0
+cd /root/repo
+
+python scripts/long_seq_bench.py --sizes 1024 --batch 16 --remat \
+  --remat-policy blocks \
+  --out perf/long_seq_4k_blocks.json 2>&1 | tail -4 || failures=$((failures+1))
+
+python bench.py 2>&1 | tail -2 || failures=$((failures+1))
+
+echo "chip_queue4: $failures item(s) failed"
+exit $failures

@@ -30,7 +30,7 @@ sys.path.insert(0, _REPO)
 
 
 def measure(size: int, attention: str, batch: int, n_steps: int = 10,
-            remat: bool = False):
+            remat: bool = False, remat_policy: str = "dots"):
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
@@ -40,7 +40,7 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10,
 
     from tpuic.config import ModelConfig, OptimConfig
     from tpuic.data.synthetic import synthetic_batch
-    from tpuic.models import create_model
+    from tpuic.models import create_model_from_config
     from tpuic.train.optimizer import make_optimizer
     from tpuic.train.state import create_train_state
     from tpuic.train.step import make_train_step
@@ -50,11 +50,16 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10,
     # keeps the measurement about the attention memory term, which is the
     # dense-vs-flash difference this bench exists to isolate.
     mcfg = ModelConfig(name="vit-b16", num_classes=1000, dtype="bfloat16",
-                       attention=attention, remat=remat)
+                       attention=attention, remat=remat,
+                       remat_policy=remat_policy)
     ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
                        milestones=())
-    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype,
-                         attention=attention)
+    # create_model_from_config, NOT create_model: the model-level remat
+    # policies ('attention' -> remat_core, 'blocks' -> remat_blocks) only
+    # flow from the CONFIG path; building the model directly would
+    # silently measure step-level remat only (XLA's own auto-remat then
+    # masks the difference at memory-pressure shapes).
+    model = create_model_from_config(mcfg)
     state = create_train_state(model, make_optimizer(ocfg),
                                jax.random.key(0), (batch, size, size, 3))
     data = synthetic_batch(batch, size, mcfg.num_classes)
@@ -75,7 +80,7 @@ def measure(size: int, attention: str, batch: int, n_steps: int = 10,
         pass
     n_tokens = (size // 16) ** 2 + 1
     return {"size": size, "tokens": n_tokens, "attention": attention,
-            "remat": remat,
+            "remat": remat, "remat_policy": remat_policy if remat else None,
             "step_ms": round(1000 * dt, 2), "peak_mem_mb": mem,
             "images_per_sec": round(batch / dt, 1),
             "platform": jax.devices()[0].platform,
@@ -89,6 +94,11 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize encoder activations (needed to "
                          "reach N>=2k at useful batch sizes)")
+    ap.add_argument("--remat-policy", default="dots",
+                    choices=("dots", "attention", "blocks"),
+                    help="what --remat recomputes (ModelConfig.remat_policy;"
+                         " 'blocks' = per-encoder-block, the long-context "
+                         "memory mode)")
     ap.add_argument("--out", default=os.path.join(_REPO, "perf",
                                                   "long_seq.json"))
     ap.add_argument("--_child", nargs=2, metavar=("SIZE", "ATTENTION"),
@@ -98,7 +108,9 @@ def main():
     if args._child:
         size, attention = int(args._child[0]), args._child[1]
         print(json.dumps(measure(size, attention, args.batch,
-                                 remat=args.remat)), flush=True)
+                                 remat=args.remat,
+                                 remat_policy=args.remat_policy)),
+              flush=True)
         return 0
 
     from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
@@ -120,6 +132,7 @@ def main():
             [sys.executable, os.path.abspath(__file__),
              "--batch", str(args.batch)]
             + (["--remat"] if args.remat else [])
+            + ["--remat-policy", args.remat_policy]
             + ["--_child", str(size), attention],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, cwd=_REPO)
@@ -154,12 +167,20 @@ def main():
             except (json.JSONDecodeError, ValueError):
                 continue
         if row is None:
-            tail = " | ".join((stderr or "").strip().splitlines()[-2:])
-            row = {"size": size, "attention": attention,
+            # Prefer the XLA OOM line (the reason this bench exists is to
+            # find it) over the generic traceback tail.
+            import re as _re
+            err_lines = [_re.sub(r"\x1b\[[0-9;]*m", "", ln)
+                         for ln in (stderr or "").strip().splitlines()]
+            oom = [ln for ln in err_lines if "Ran out of memory" in ln]
+            tail = (oom[0].split("error.", 1)[-1].strip() if oom
+                    else " | ".join(err_lines[-2:]))
+            row = {"size": size, "attention": attention, "oom": bool(oom),
                    "error": f"rc={rc}: {tail[:300]}"}
         rows.append(row)
         print(json.dumps(row), flush=True)
     out = {"batch": args.batch, "model": "vit-b16", "remat": args.remat,
+           "remat_policy": args.remat_policy if args.remat else None,
            "rows": rows}
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

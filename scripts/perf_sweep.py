@@ -131,9 +131,11 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="also measure remat=True at each batch size")
     ap.add_argument("--remat-policy", default="dots",
-                    choices=["dots", "attention"],
+                    choices=["dots", "attention", "blocks"],
                     help="policy for the remat rows: 'attention' recomputes "
-                         "only the [B,H,N,N] ViT tensors (see ModelConfig)")
+                         "only the [B,H,N,N] ViT tensors; 'blocks' = "
+                         "per-encoder-block, the long-context memory mode "
+                         "(see ModelConfig)")
     ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
     args = ap.parse_args()
 

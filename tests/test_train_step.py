@@ -205,6 +205,21 @@ def test_attention_remat_policy_matches_plain_step():
                                rtol=1e-5)
 
 
+def _residual_sizes(state, x):
+    """Leaf sizes of the vjp residuals of the forward pass."""
+    def fwd(params, x):
+        return state.apply_fn({"params": params}, x, train=False)
+    _, vjp_fn = jax.vjp(fwd, state.params, x)
+    return [l.size for l in jax.tree_util.tree_leaves(vjp_fn)
+            if hasattr(l, "size")]
+
+
+# vit-tiny at 32px, patch 4, batch 4: N = 65 tokens, 4 heads, hidden 64.
+_VIT_QUAD = 4 * 4 * 65 * 65         # B * heads * N * N
+_VIT_MLP_HIDDEN = 4 * 65 * 4 * 64   # B * N * 4*hidden (GELU input)
+_VIT_BOUNDARY = 4 * 65 * 64         # B * N * hidden (block input)
+
+
 def test_attention_remat_drops_quadratic_residuals_only():
     """Both halves of the remat_core contract, driven through the
     PRODUCTION config path (create_model_from_config sets ViT.remat_core):
@@ -215,22 +230,55 @@ def test_attention_remat_drops_quadratic_residuals_only():
     sel_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="attention")
     x = jnp.asarray(synthetic_batch(4, 32, 3)["image"])
 
-    def residual_sizes(state):
-        def fwd(params, x):
-            return state.apply_fn({"params": params}, x, train=False)
-        _, vjp_fn = jax.vjp(fwd, state.params, x)
-        return [l.size for l in jax.tree_util.tree_leaves(vjp_fn)
-                if hasattr(l, "size")]
+    plain = _residual_sizes(_vit_state(mcfg), x)
+    selective = _residual_sizes(_vit_state(sel_cfg), x)
+    assert any(s == _VIT_QUAD for s in plain)
+    assert any(s == _VIT_MLP_HIDDEN for s in plain)
+    assert not any(s == _VIT_QUAD for s in selective)
+    assert any(s == _VIT_MLP_HIDDEN for s in selective)
 
-    # vit-tiny at 32px, patch 4: N = 65 tokens, 4 heads, hidden 64.
-    quad = 4 * 4 * 65 * 65          # B * heads * N * N
-    mlp_hidden = 4 * 65 * 4 * 64    # B * N * 4*hidden (GELU input)
-    plain = residual_sizes(_vit_state(mcfg))
-    selective = residual_sizes(_vit_state(sel_cfg))
-    assert any(s == quad for s in plain)
-    assert any(s == mlp_hidden for s in plain)
-    assert not any(s == quad for s in selective)
-    assert any(s == mlp_hidden for s in selective)
+
+def test_blocks_remat_policy_matches_plain_step():
+    """remat_policy='blocks' (ViT remat_blocks: each encoder block under
+    nn.remat) must be identical numerics to the un-remat step."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    blk_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="blocks")
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(4, 32, 3).items()}
+    plain = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+    blk = make_train_step(OCFG, blk_cfg, mesh=None, donate=False)
+    _, m1 = plain(_vit_state(mcfg), batch)
+    _, m2 = blk(_vit_state(blk_cfg), batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(m1["grad_norm"]), float(m2["grad_norm"]),
+                               rtol=1e-5)
+
+
+def test_blocks_remat_drops_all_block_internal_residuals():
+    """The 'blocks' contract (the long-context memory mode,
+    PERF_ANALYSIS.md §10f): NEITHER the [B,H,N,N] attention tensors NOR
+    the [B,N,4D] MLP activations survive to the backward — only
+    block-boundary [B,N,D] activations do. This is exactly the split that
+    separates it from 'attention' (drops quad only) and 'dots' (keeps
+    matmul outputs)."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    blk_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="blocks")
+    x = jnp.asarray(synthetic_batch(4, 32, 3)["image"])
+
+    blocks = _residual_sizes(_vit_state(blk_cfg), x)
+    assert not any(s == _VIT_QUAD for s in blocks)
+    assert not any(s == _VIT_MLP_HIDDEN for s in blocks)
+    assert any(s == _VIT_BOUNDARY for s in blocks)
+
+
+def test_ineffective_blocks_remat_warns():
+    """--remat --remat-policy blocks on a model without the ViT encoder
+    applies NO remat; loud beats a silent OOM."""
+    with pytest.warns(UserWarning, match="no effect"):
+        make_train_step(
+            OCFG,
+            dataclasses.replace(MCFG, remat=True, remat_policy="blocks"),
+            mesh=None, donate=False)
 
 
 def test_unknown_remat_policy_rejected():

@@ -110,6 +110,14 @@ class ModelConfig:
     #                 are never residuals; recompute is one einsum + softmax
     #                 per layer. No-op for models/impls with no dense
     #                 attention core (ResNet; flash never materializes it).
+    #   'blocks'    — ViT ``remat_blocks``: each encoder block under
+    #                 nn.remat with the save-nothing policy, so the only
+    #                 N-sized residuals are the block inputs and the
+    #                 backward recomputes one block at a time. The
+    #                 long-context memory mode: at N=4097/b16 'dots' needs
+    #                 19.5 GB (flash) / 41.1 GB (dense) vs 15.75 HBM
+    #                 (PERF_ANALYSIS.md §10f). Composes with any attention
+    #                 impl; ViT-only (warns and no-ops elsewhere).
     remat_policy: str = "dots"
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4

@@ -213,6 +213,17 @@ class ViT(nn.Module):
     drop_path: float = 0.0
     # See MultiHeadAttention.remat_core.
     remat_core: bool = False
+    # Per-block remat (ModelConfig.remat_policy='blocks'): every encoder
+    # block runs under nn.remat with the default save-nothing policy, so
+    # the only sequence-length-sized residuals are the 12 block INPUTS
+    # ([B,N,D], ~100 MB at b16/N=4097) and the backward recomputes one
+    # block at a time. This is the long-context memory mode: at N=4097 the
+    # 'dots' policy OOMs by saving every [B,N,4D] mlp_up output (4.6 GB)
+    # plus attention outputs — measured 19.5 GB vs 15.75 HBM
+    # (PERF_ANALYSIS.md §10f). Composes with any attention impl; with
+    # 'flash' the per-block recompute peak is O(N·D), which is what lets
+    # flash train through shapes where dense cannot even rematerialize.
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -229,17 +240,20 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, x.shape[1], self.hidden), self.param_dtype)
         x = x + pos.astype(self.dtype)
+        # static_argnums counts self: (self, x, deterministic) -> 2.
+        block_cls = (nn.remat(EncoderBlock, static_argnums=(2,))
+                     if self.remat_blocks else EncoderBlock)
         for i in range(self.depth):
             moe = (self.moe_experts
                    if self.moe_experts
                    and i % self.moe_every == self.moe_every - 1 else 0)
             dp = (self.drop_path * i / max(1, self.depth - 1)
                   if self.drop_path else 0.0)
-            x = EncoderBlock(self.num_heads, self.mlp_ratio, self.dropout,
-                             self.dtype, self.param_dtype, self.attention,
-                             self.mesh, moe, dp,
-                             remat_core=self.remat_core,
-                             name=f"block{i}")(x, deterministic=not train)
+            x = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
+                          self.dtype, self.param_dtype, self.attention,
+                          self.mesh, moe, dp,
+                          remat_core=self.remat_core,
+                          name=f"block{i}")(x, not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)
         return x[:, 0].astype(jnp.float32)

@@ -97,8 +97,21 @@ def resolve_remat_policy(model_cfg: ModelConfig):
                 "remat_policy='dots' for whole-forward remat.",
                 stacklevel=2)
         return None
+    if model_cfg.remat_policy == "blocks":
+        # Per-encoder-block nn.remat lives in the model (ViT
+        # ``remat_blocks``): residuals are the block inputs only, the
+        # backward recomputes one block at a time. The long-context
+        # memory mode — see ModelConfig.remat_policy.
+        if "vit" not in model_cfg.name:
+            warnings.warn(
+                f"remat_policy='blocks' has no effect for model="
+                f"'{model_cfg.name}': only the ViT encoder has "
+                "per-block remat; NO remat is applied. Use "
+                "remat_policy='dots' for whole-forward remat.",
+                stacklevel=2)
+        return None
     raise ValueError(f"unknown remat_policy '{model_cfg.remat_policy}'; "
-                     f"available: ['dots', 'attention']")
+                     f"available: ['dots', 'attention', 'blocks']")
 
 
 def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,

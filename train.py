@@ -139,10 +139,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize the forward in backward (trade FLOPs "
                         "for activation memory/bandwidth)")
     p.add_argument("--remat-policy", default="dots",
-                   choices=["dots", "attention"],
+                   choices=["dots", "attention", "blocks"],
                    help="what --remat saves: 'dots' recomputes all "
                         "activation-sized tensors; 'attention' recomputes "
-                        "ONLY the [B,H,N,N] attention logits/probs (ViT)")
+                        "ONLY the [B,H,N,N] attention logits/probs (ViT); "
+                        "'blocks' saves only encoder-block inputs (ViT "
+                        "long-context memory mode)")
     p.add_argument("--drop-path", type=float, default=0.0,
                    help="stochastic-depth rate for ViT backbones (last "
                         "block; linear DeiT ramp from 0)")
