@@ -181,3 +181,70 @@ def test_lenient_restore_across_architectures(tmp_path):
     state4 = _state(num_classes=4)
     restored, _, _ = mgr.restore_into(state4, "best")
     assert np.asarray(restored.params["head"]["out"]["kernel"]).shape == (32, 4)
+
+
+def test_mid_epoch_save_restores_step_exact(tmp_path):
+    """A preemption flush with step_in_epoch resumes at (SAME epoch, step)
+    — not epoch+1 — and flags the offset for the Trainer."""
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "resnet18-cifar")
+    mgr.save_latest(state, epoch=5, best_score=70.0, step_in_epoch=17)
+    mgr.wait()  # commit the async save before a DIFFERENT manager reads
+
+    mgr2 = CheckpointManager(str(tmp_path), "resnet18-cifar")
+    restored, start_epoch, best = mgr2.restore_into(_state(), "latest")
+    assert start_epoch == 5                       # continue THAT epoch
+    assert mgr2.last_restore_step_in_epoch == 17  # ...at this step
+    assert mgr2.last_restore_loaded is None       # sharded fast path
+
+
+def test_legacy_checkpoint_without_step_key_keeps_fast_path(tmp_path):
+    """Checkpoints written before meta.step_in_epoch existed must still
+    restore through the sharded fast path (no host gather, no lenient
+    merge) — the template is retried in the legacy layout."""
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "resnet18-cifar")
+    orig = mgr._payload
+
+    def legacy_payload(state, epoch, best_score, gather=False,
+                       step_in_epoch=-1, global_batch=-1, data_seed=-1,
+                       data_len=-1):
+        p = orig(state, epoch, best_score, gather=gather)
+        # pre-round-4 on-disk layout: no resume-offset/geometry keys
+        for k in ("step_in_epoch", "global_batch", "data_seed", "data_len"):
+            del p["meta"][k]
+        return p
+
+    mgr._payload = legacy_payload
+    mgr.save_latest(state, epoch=3, best_score=50.0)
+    mgr.wait()
+
+    mgr2 = CheckpointManager(str(tmp_path), "resnet18-cifar")
+    restored, start_epoch, best = mgr2.restore_into(_state(), "latest")
+    assert start_epoch == 4                   # normal end-of-epoch resume
+    assert mgr2.last_restore_step_in_epoch is None
+    assert mgr2.last_restore_loaded is None   # fast path, NOT lenient
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_mid_epoch_checkpoint_degraded_restore_replays_epoch(tmp_path):
+    """A mid-epoch flush restored through the DEGRADED (lenient) path —
+    here: into a different architecture, partial param match — must
+    replay the interrupted epoch from its start (start_epoch == saved
+    epoch, no step offset), never skip its untrained tail."""
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_latest(state, epoch=5, best_score=70.0, step_in_epoch=17)
+    mgr.wait()
+
+    other = create_train_state(
+        create_model("resnet18", 3, dtype="float32"), make_optimizer(OCFG),
+        jax.random.key(1), (2, 32, 32, 3))
+    mgr2 = CheckpointManager(str(tmp_path), "m")
+    restored, start_epoch, best = mgr2.restore_into(other, "latest")
+    n_loaded, n_total = mgr2.last_restore_loaded
+    assert 0 < n_loaded < n_total          # genuinely the degraded path
+    assert start_epoch == 5                # replay epoch 5...
+    assert mgr2.last_restore_step_in_epoch is None  # ...from step 0

@@ -155,3 +155,31 @@ def test_dataset_smaller_than_global_batch(imagefolder):
     mask = np.asarray(batches[0]["mask"])
     assert mask.sum() == n  # every real sample exactly once
     assert mask.shape[0] == gb
+
+def test_loader_epoch_start_step_serves_identical_remainder(imagefolder):
+    """Step-exact resume contract (checkpoint/manager.py step_in_epoch):
+    epoch(e, start_step=s) yields exactly batches s.. of epoch(e) — same
+    images, labels, masks, and per-sample augment outputs — so a resumed
+    epoch trains the untouched remainder bit-identically."""
+    ds = ImageFolderDataset(imagefolder, "train", 16)
+    loader = Loader(ds, global_batch=4, mesh=None, num_workers=1)
+    full = list(loader.epoch(2))
+    tail = list(loader.epoch(2, start_step=2))
+    assert len(tail) == len(full) - 2
+    for want, got in zip(full[2:], tail):
+        np.testing.assert_array_equal(np.asarray(want["image"]),
+                                      np.asarray(got["image"]))
+        np.testing.assert_array_equal(np.asarray(want["label"]),
+                                      np.asarray(got["label"]))
+        np.testing.assert_array_equal(np.asarray(want["mask"]),
+                                      np.asarray(got["mask"]))
+        assert want.image_ids == got.image_ids
+        np.testing.assert_array_equal(want.indices, got.indices)
+
+
+def test_loader_epoch_start_step_bounds(imagefolder):
+    ds = ImageFolderDataset(imagefolder, "train", 16)
+    loader = Loader(ds, global_batch=4, mesh=None, num_workers=1)
+    with pytest.raises(ValueError, match="start_step"):
+        list(loader.epoch(0, start_step=len(loader) + 1))
+    assert list(loader.epoch(0, start_step=len(loader))) == []

@@ -259,3 +259,22 @@ def test_resident_upload_chunked(tree, tmp_path, monkeypatch):
     # The loader still serves correct batches through the chunked copy.
     batches = list(loader.epoch(0))
     assert len(batches) == len(loader)
+
+
+def test_packed_loader_start_step_serves_identical_remainder(tree, tmp_path):
+    """Step-exact resume on the packed path (the production loader):
+    epoch(e, start_step=s) == batches s.. of epoch(e), including the
+    on-device augment output (same (seed, epoch, index) draws)."""
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    ds = ImageFolderDataset(tree, "train", 32, cfg)
+    packed = pack_dataset(ds, str(tmp_path / "c5"), verbose=False)
+    loader = Loader(packed, global_batch=4, seed=7)
+    full = list(loader.epoch(3))
+    tail = list(loader.epoch(3, start_step=2))
+    assert len(tail) == len(full) - 2
+    for want, got in zip(full[2:], tail):
+        np.testing.assert_array_equal(np.asarray(want["image"]),
+                                      np.asarray(got["image"]))
+        np.testing.assert_array_equal(np.asarray(want["label"]),
+                                      np.asarray(got["label"]))
+        assert want.image_ids == got.image_ids

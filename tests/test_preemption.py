@@ -3,13 +3,17 @@
 The reference has no preemption handling (SURVEY.md §5 "Failure detection:
 Absent") — a killed worker loses everything since the last periodic save.
 Here the Trainer polls a signal latch between steps; the contract under
-test: the interrupted epoch is REPLAYED on resume, never skipped."""
+test: resume is STEP-EXACT — the flush records the interrupted epoch's
+completed step count, resume continues that epoch at that step, and the
+(interrupt + resume) trajectory is bitwise the uninterrupted one (epoch
+order and every RNG stream are deterministic in epoch/step/index)."""
 
 import os
 import signal
 
+import jax
 import numpy as np
-import pytest
+import pytest  # noqa: F401
 
 from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
                           OptimConfig, RunConfig)
@@ -45,7 +49,23 @@ def _cfg(root, ckpt, epochs):
     )
 
 
-def test_preempted_fit_flushes_and_resume_replays_epoch(tmp_path):
+def _trip_after(trainer, n_steps):
+    """Wrap trainer.train_step to latch preemption after n_steps calls;
+    returns the call-count list."""
+    orig, calls = trainer.train_step, []
+
+    def counting_step(state, batch):
+        out = orig(state, batch)
+        calls.append(1)
+        if len(calls) == n_steps:
+            trainer.preemption.trigger()
+        return out
+
+    trainer.train_step = counting_step
+    return calls
+
+
+def test_preempted_fit_flushes_and_resume_continues_step_exact(tmp_path):
     root = str(tmp_path / "data")
     make_synthetic_imagefolder(root, classes=("a", "b"), per_class=16,
                                size=24)
@@ -54,29 +74,56 @@ def test_preempted_fit_flushes_and_resume_replays_epoch(tmp_path):
     trainer = Trainer(_cfg(root, ckpt, epochs=3))
     steps_per_epoch = trainer.train_loader.steps_per_epoch()
     assert steps_per_epoch >= 2
-    # Trip the latch mid-way through epoch 1.
+    # Trip the latch after 1 completed step of epoch 1.
     trip_at = steps_per_epoch + 1
-    orig, calls = trainer.train_step, []
-
-    def counting_step(state, batch):
-        out = orig(state, batch)
-        calls.append(1)
-        if len(calls) == trip_at:
-            trainer.preemption.trigger()
-        return out
-
-    trainer.train_step = counting_step
+    calls = _trip_after(trainer, trip_at)
     trainer.fit()
-    # Stopped inside epoch 1: no further steps, no epoch-2 work.
-    assert len(calls) < 2 * steps_per_epoch
+    # The loop acts on the latch before the NEXT step: exactly trip_at
+    # steps ran, and the flush recorded 1 completed step of epoch 1.
+    assert len(calls) == trip_at
     assert os.path.isdir(os.path.join(ckpt, "resnet18-cifar", "latest"))
 
-    # Resume: the interrupted epoch (1) is replayed, then training finishes.
+    # Resume: epoch 1 CONTINUES at step 1 — not replayed, not skipped.
     resumed = Trainer(_cfg(root, ckpt, epochs=3))
     assert resumed.start_epoch == 1
+    assert resumed.start_step == 1
+    calls2 = _trip_after(resumed, 10**9)  # count only
     resumed.fit()
-    # A completed run's latest/meta reflects the final epochs.
-    assert resumed.best_score >= 0.0
+    # Total steps across both runs = exactly 3 full epochs.
+    assert len(calls) + len(calls2) == 3 * steps_per_epoch
+
+
+def test_interrupted_resume_matches_uninterrupted_run_bitwise(tmp_path):
+    """The gold contract: (train, SIGTERM mid-epoch, resume, finish) ends
+    at EXACTLY the state of a never-interrupted run — same epoch
+    permutations, same per-sample augment draws, same per-step RNG (all
+    keyed by epoch/index/optimizer-step, none of it wall-clock)."""
+    root = str(tmp_path / "data")
+    # 48 images / (2x8 fake devices) = 3 steps per epoch, so the trip
+    # below lands strictly inside epoch 1 (not on its boundary).
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=24,
+                               size=24)
+
+    straight = Trainer(_cfg(root, str(tmp_path / "ck_a"), epochs=2))
+    steps_per_epoch = straight.train_loader.steps_per_epoch()
+    assert steps_per_epoch == 3
+    straight.fit()
+
+    interrupted = Trainer(_cfg(root, str(tmp_path / "ck_b"), epochs=2))
+    _trip_after(interrupted, steps_per_epoch + 2)  # 2 steps into epoch 1
+    interrupted.fit()
+    resumed = Trainer(_cfg(root, str(tmp_path / "ck_b"), epochs=2))
+    assert (resumed.start_epoch, resumed.start_step) == (1, 2)
+    resumed.fit()
+
+    a = jax.device_get(straight.state.params)
+    b = jax.device_get(resumed.state.params)
+    for pa, pb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(straight.state.step)),
+        np.asarray(jax.device_get(resumed.state.step)))
 
 
 def test_preemption_before_first_epoch_resumes_at_zero(tmp_path):
@@ -91,3 +138,63 @@ def test_preemption_before_first_epoch_resumes_at_zero(tmp_path):
     trainer.fit()
     resumed = Trainer(_cfg(root, ckpt, epochs=2))
     assert resumed.start_epoch == 0
+
+
+def test_boundary_preemption_resumes_into_pending_val(tmp_path):
+    """Signal landing ON the epoch boundary (training done, val not yet
+    run): the flush records step_in_epoch == steps_per_epoch, and resume
+    trains ZERO further steps of that epoch but DOES run its pending
+    validation — best/val are never lost to boundary timing."""
+    import json
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=24,
+                               size=24)
+    ckpt = str(tmp_path / "ckpt")
+
+    trainer = Trainer(_cfg(root, ckpt, epochs=2))
+    steps_per_epoch = trainer.train_loader.steps_per_epoch()
+    calls = _trip_after(trainer, steps_per_epoch)  # last step of epoch 0
+    trainer.fit()
+    assert len(calls) == steps_per_epoch
+    meta = json.load(open(os.path.join(ckpt, "resnet18-cifar",
+                                       "latest.meta.json")))
+    assert (meta["epoch"], meta["best_score"]) == (0, 0.0)
+    assert meta["step_in_epoch"] == steps_per_epoch
+    assert meta["global_batch"] == 16  # 2/chip x 8 fake devices
+    # Val never ran: no best track yet.
+    assert not os.path.isdir(os.path.join(ckpt, "resnet18-cifar", "best"))
+
+    resumed = Trainer(_cfg(root, ckpt, epochs=2))
+    assert (resumed.start_epoch, resumed.start_step) == (0, steps_per_epoch)
+    calls2 = _trip_after(resumed, 10**9)
+    resumed.fit()
+    # Epoch 0 trains zero further steps; epoch 1 runs in full.
+    assert len(calls2) == steps_per_epoch
+    # ...and epoch 0's pending validation ran on resume (best was saved).
+    assert os.path.isdir(os.path.join(ckpt, "resnet18-cifar", "best"))
+    assert resumed.best_score > 0.0
+
+
+def test_resume_with_changed_global_batch_replays_epoch(tmp_path):
+    """A mid-epoch step offset is only valid for the loader geometry it
+    was flushed under: resuming with a different global batch must warn
+    and replay the epoch from its start, not skip the wrong samples."""
+    import dataclasses
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=24,
+                               size=24)
+    ckpt = str(tmp_path / "ckpt")
+
+    trainer = Trainer(_cfg(root, ckpt, epochs=3))
+    steps_per_epoch = trainer.train_loader.steps_per_epoch()
+    _trip_after(trainer, steps_per_epoch + 1)  # 1 step into epoch 1
+    trainer.fit()
+
+    cfg2 = _cfg(root, ckpt, epochs=3)
+    cfg2 = dataclasses.replace(
+        cfg2, data=dataclasses.replace(cfg2.data, batch_size=3))
+    resumed = Trainer(cfg2)
+    assert resumed.start_epoch == 1   # still the interrupted epoch...
+    assert resumed.start_step == 0    # ...but replayed from its start

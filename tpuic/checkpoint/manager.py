@@ -87,6 +87,14 @@ def lenient_restore(current: Dict, restored: Dict) -> Tuple[Dict, int, int]:
     return _unflatten(merged), loaded, len(cur)
 
 
+# Resume metadata introduced in round 4, enumerated ONCE: _payload writes
+# these keys, _abstract_payload's legacy template deletes exactly these,
+# and _read_resume_meta reads the geometry subset — a single list keeps
+# the three sites (and the on-disk layout contract) from drifting.
+RESUME_META_KEYS = ("step_in_epoch", "global_batch", "data_seed", "data_len")
+GEOMETRY_META_KEYS = ("global_batch", "data_seed", "data_len")
+
+
 class CheckpointManager:
     """best/latest checkpoint tracks under ``{ckpt_dir}/{name}``."""
 
@@ -104,7 +112,9 @@ class CheckpointManager:
 
     # -- save ---------------------------------------------------------------
     def _payload(self, state, epoch: int, best_score: float,
-                 gather: bool = False):
+                 gather: bool = False, step_in_epoch: int = -1,
+                 global_batch: int = -1, data_seed: int = -1,
+                 data_len: int = -1):
         """Checkpoint pytree. ``gather=False`` keeps arrays wherever they
         live (sharded jax.Arrays stay sharded — each host saves only its
         addressable shards); ``gather=True`` materializes numpy on host
@@ -113,12 +123,33 @@ class CheckpointManager:
             to_host = lambda t: jax.tree.map(np.asarray, jax.device_get(t))  # noqa: E731
         else:
             to_host = lambda t: t  # noqa: E731
+        # NOTE: the "meta" key set below is FROZEN. Orbax's fast-path
+        # restore requires an exact structure match, and restore_into
+        # enumerates historical layouts as whole templates (current +
+        # pre-step_in_epoch legacy) — every new key here would strand
+        # today's checkpoints on the host-gather lenient path. Add future
+        # run metadata to the sidecar JSON (_save), which has no
+        # structure-match constraint, not here.
         payload = {
             "params": to_host(state.params),
             "batch_stats": to_host(state.batch_stats),
             "opt_state": to_host(state.opt_state),
             "meta": {"epoch": np.int64(epoch),
                      "best_score": np.float64(best_score),
+                     # >= 0: completed steps of epoch ``epoch`` at a
+                     # preemption flush; resume continues that epoch at
+                     # this step (== steps_per_epoch: training done, only
+                     # val pending). -1: normal end-of-epoch save.
+                     "step_in_epoch": np.int64(step_in_epoch),
+                     # Loader geometry at a step_in_epoch flush: the
+                     # epoch permutation is keyed by (seed, n_samples) and
+                     # sliced by global_batch, so a resume differing in ANY
+                     # of the three cannot reuse the step offset (it would
+                     # skip the wrong samples) and falls back to replaying
+                     # the epoch. -1: not recorded.
+                     "global_batch": np.int64(global_batch),
+                     "data_seed": np.int64(data_seed),
+                     "data_len": np.int64(data_len),
                      "step": np.asarray(jax.device_get(state.step))},
         }
         if getattr(state, "ema_params", None) is not None:
@@ -130,10 +161,17 @@ class CheckpointManager:
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
 
-    def _save(self, track: str, state, epoch: int, best_score: float) -> None:
+    def _save(self, track: str, state, epoch: int, best_score: float,
+              step_in_epoch: int = -1, global_batch: int = -1,
+              data_seed: int = -1, data_len: int = -1) -> None:
         path = os.path.join(self.root, track)
         self.wait()  # one in-flight save at a time; also orders best/latest
-        self._ckptr.save(path, self._payload(state, epoch, best_score),
+        self._ckptr.save(path,
+                         self._payload(state, epoch, best_score,
+                                       step_in_epoch=step_in_epoch,
+                                       global_batch=global_batch,
+                                       data_seed=data_seed,
+                                       data_len=data_len),
                          force=True)
         if jax.process_index() == 0:
             # Sidecar: lets resume pick the newest track without a full
@@ -141,7 +179,11 @@ class CheckpointManager:
             # mid-write can at worst leave a stale (not future) epoch.
             with open(os.path.join(self.root, f"{track}.meta.json"), "w") as f:
                 json.dump({"epoch": int(epoch),
-                           "best_score": float(best_score)}, f)
+                           "best_score": float(best_score),
+                           "step_in_epoch": int(step_in_epoch),
+                           "global_batch": int(global_batch),
+                           "data_seed": int(data_seed),
+                           "data_len": int(data_len)}, f)
 
     def save_best(self, state, epoch: int, best_score: float) -> None:
         """Reference train.py:173-180 — on val-accuracy improvement."""
@@ -155,10 +197,22 @@ class CheckpointManager:
         if epoch % self.save_period == 0:
             self.save_latest(state, epoch, best_score)
 
-    def save_latest(self, state, epoch: int, best_score: float) -> None:
-        """Unconditional ``latest`` save (preemption flush; period ignored)."""
-        self._save("latest", state, epoch, best_score)
-        host0_print(f"[ckpt] latest -> {self.root}/latest (epoch {epoch})")
+    def save_latest(self, state, epoch: int, best_score: float,
+                    step_in_epoch: int = -1, global_batch: int = -1,
+                    data_seed: int = -1, data_len: int = -1) -> None:
+        """Unconditional ``latest`` save (preemption flush; period ignored).
+
+        ``step_in_epoch >= 0`` marks a PARTIAL epoch: ``epoch`` has that
+        many completed steps and resume continues it step-exactly (the
+        epoch permutation and every per-step/per-sample RNG stream are
+        deterministic in (seed, epoch, index) / optimizer step, so the
+        continued run is bitwise the uninterrupted one)."""
+        self._save("latest", state, epoch, best_score,
+                   step_in_epoch=step_in_epoch, global_batch=global_batch,
+                   data_seed=data_seed, data_len=data_len)
+        at = (f"epoch {epoch}" if step_in_epoch < 0
+              else f"epoch {epoch}, step {step_in_epoch}")
+        host0_print(f"[ckpt] latest -> {self.root}/latest ({at})")
 
     # -- restore ------------------------------------------------------------
     def _track_epoch(self, track: str) -> Optional[int]:
@@ -185,12 +239,17 @@ class CheckpointManager:
             return None
         return max(candidates, key=lambda p: p[0])[1]
 
-    def _abstract_payload(self, state):
+    def _abstract_payload(self, state, legacy_meta: bool = False):
         """(template, restore_args) for a restore directly into the live
         state's shardings: every array leaf becomes a ShapeDtypeStruct whose
         sharding is the leaf's own, so Orbax hands back sharded jax.Arrays
         without ever materializing the full state on one host (FSDP-scale
-        safe — VERDICT r2 weak #5)."""
+        safe — VERDICT r2 weak #5).
+
+        ``legacy_meta`` drops the ``step_in_epoch`` meta key so checkpoints
+        written before that key existed still take this fast path (Orbax's
+        PyTreeRestore requires the template structure to match the stored
+        tree exactly)."""
         def abstract(leaf):
             if isinstance(leaf, jax.Array):
                 return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
@@ -203,7 +262,23 @@ class CheckpointManager:
                                             dtype=leaf.dtype)
             return ocp.RestoreArgs()
         payload = self._payload(state, 0, 0.0)
+        if legacy_meta:
+            for k in RESUME_META_KEYS:
+                del payload["meta"][k]
         return (jax.tree.map(abstract, payload), jax.tree.map(args, payload))
+
+    def _read_resume_meta(self, meta):
+        """Parse the resume-relevant scalars out of a restored meta tree
+        and publish them on the manager (single reader for BOTH restore
+        branches, so they can never desynchronize). Returns
+        (epoch, best_score, step_in_epoch)."""
+        epoch = int(meta.get("epoch", 0))
+        best = float(meta.get("best_score", 0.0))
+        sie = int(meta.get("step_in_epoch", -1))
+        self.last_restore_meta = (epoch, sie)
+        self.last_restore_geometry = tuple(
+            int(meta.get(k, -1)) for k in GEOMETRY_META_KEYS)
+        return epoch, best, sie
 
     def restore_into(self, state, track: Optional[str] = None):
         """Lenient restore of ``state`` (reference train.py:132-153).
@@ -227,6 +302,18 @@ class CheckpointManager:
         # leaves matched" from a legitimate restore without changing the
         # return contract.
         self.last_restore_loaded = None
+        # Completed steps of a partially-trained epoch (mid-epoch preemption
+        # flush); None when the checkpoint is a normal end-of-epoch save.
+        # Used by the Trainer together with the returned start_epoch.
+        self.last_restore_step_in_epoch = None
+        # (global_batch, data_seed, data_len) recorded at a mid-epoch
+        # flush; None when absent (-1 entries: not recorded). The Trainer
+        # refuses the step offset unless ALL match the live loader.
+        self.last_restore_geometry = None
+        # (saved_epoch, step_in_epoch) of whatever was read — for callers
+        # that report provenance (predict) regardless of which restore
+        # branch ran.
+        self.last_restore_meta = None
         if track is None:
             track = self.newest_track()
             if track is None:
@@ -236,26 +323,36 @@ class CheckpointManager:
             return state, 0, 0.0
         # Fast path: restore into the live shardings. Exact match required —
         # a cross-architecture checkpoint raises (shape/structure mismatch)
-        # and drops to the lenient host-side path below.
-        try:
-            template, restore_args = self._abstract_payload(state)
-            restored = self._ckptr.restore(
-                path, args=ocp.args.PyTreeRestore(
-                    item=template, restore_args=restore_args))
+        # and drops to the lenient host-side path below. Tried twice:
+        # current meta layout first, then the pre-step_in_epoch legacy
+        # layout, so old checkpoints keep the no-host-gather path instead
+        # of silently degrading to the lenient one.
+        for legacy_meta in (False, True):
+            try:
+                template, restore_args = self._abstract_payload(
+                    state, legacy_meta=legacy_meta)
+                restored = self._ckptr.restore(
+                    path, args=ocp.args.PyTreeRestore(
+                        item=template, restore_args=restore_args))
+            except Exception:
+                continue
             meta = restored.get("meta", {})
-            epoch = int(meta.get("epoch", 0))
-            best = float(meta.get("best_score", 0.0))
+            epoch, best, sie = self._read_resume_meta(meta)
             state = state.replace(params=restored["params"],
                                   batch_stats=restored["batch_stats"],
                                   opt_state=restored["opt_state"],
                                   step=np.asarray(meta.get("step", 0)))
             if "ema_params" in restored:
                 state = state.replace(ema_params=restored["ema_params"])
+            if sie >= 0:
+                # Mid-epoch flush: continue THAT epoch at the saved step.
+                self.last_restore_step_in_epoch = sie
+                host0_print(f"[ckpt] restored (sharded) from {path} "
+                            f"(epoch {epoch} at step {sie}, best {best:.4f})")
+                return state, epoch, best
             host0_print(f"[ckpt] restored (sharded) from {path} "
                         f"(epoch {epoch}, best {best:.4f})")
             return state, epoch + 1, best
-        except Exception:
-            pass
         # Lenient path: host-side key-intersection merge. Restoring against
         # a structure template keeps optax's opt_state pytree types
         # (NamedTuples) instead of raw nested lists; when even the template
@@ -286,18 +383,32 @@ class CheckpointManager:
                 state = state.replace(
                     ema_params=jax.tree.map(np.copy, merged_params))
         meta = restored.get("meta", {})
-        epoch = int(meta.get("epoch", 0))
-        best = float(meta.get("best_score", 0.0))
+        epoch, best, sie = self._read_resume_meta(meta)
+        opt_ok = False
         if n_loaded == n_total:
             step = meta.get("step")
             if step is not None:
                 state = state.replace(step=np.asarray(step))
             try:
                 state = state.replace(opt_state=restored["opt_state"])
+                opt_ok = step is not None
             except (KeyError, TypeError):
                 host0_print("[ckpt] opt_state structure mismatch — optimizer "
                             "state reset")
         host0_print(f"[ckpt] restored {n_loaded}/{n_total} param leaves from "
                     f"{path} (epoch {epoch}, best {best:.4f})")
         self.last_restore_loaded = (n_loaded, n_total)
+        if sie >= 0 and n_loaded == n_total and opt_ok:
+            # Step-exact continuation only for a FULL restore including the
+            # optimizer moments and step counter — continuing mid-epoch on
+            # a reset optimizer would silently break the bitwise-resume
+            # contract; replaying the epoch from its start is the honest
+            # fallback there.
+            self.last_restore_step_in_epoch = sie
+            return state, epoch, best
+        if sie >= 0 and n_loaded:
+            # Mid-epoch checkpoint through the degraded path: REPLAY the
+            # interrupted epoch (start at its step 0) — returning epoch+1
+            # here would silently skip its untrained tail.
+            return state, epoch, best
         return state, epoch + 1 if n_loaded else 0, best

@@ -236,14 +236,24 @@ class Loader:
         img, label, image_id = self.dataset.load(int(index), rng)
         return position, img, label, image_id, valid
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
-        """Yield batches for this epoch (the set_epoch(e) equivalent)."""
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[Batch]:
+        """Yield batches for this epoch (the set_epoch(e) equivalent).
+
+        ``start_step`` skips the first batches — step-exact resume: the
+        epoch order is a (seed, epoch)-deterministic permutation and the
+        augment stream is (seed, epoch, index)-keyed, so the skipped
+        prefix is exactly the batches a preempted run already trained and
+        the remainder is served bit-identically to the uninterrupted
+        epoch."""
         n = len(self.dataset)
         order, n_valid = _epoch_indices(n, epoch, self.seed, self.shuffle,
                                         self.global_batch)
         n_batches = len(order) // self.global_batch
         if self.drop_last and n % self.global_batch:
             n_batches -= 1
+        if not 0 <= start_step <= n_batches:
+            raise ValueError(f"start_step {start_step} outside this epoch's "
+                             f"0..{n_batches} steps")
         out_q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
@@ -275,7 +285,7 @@ class Loader:
             ds, c = self.dataset, self.dataset.cfg
             s = ds.resize_size
             augment = self.augment
-            for b in range(n_batches):
+            for b in range(start_step, n_batches):
                 if stop.is_set():
                     break
                 lo = b * self.global_batch + self.process_index * self.local_batch
@@ -320,7 +330,7 @@ class Loader:
             if self.packed:
                 return _produce_packed_loop()
             with ThreadPoolExecutor(self.num_workers) as pool:
-                for b in range(n_batches):
+                for b in range(start_step, n_batches):
                     if stop.is_set():
                         break
                     lo = b * self.global_batch + self.process_index * self.local_batch

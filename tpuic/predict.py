@@ -140,8 +140,19 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
                 f"checkpoint {mgr.root}/{track} restored only "
                 f"{loaded[0]}/{loaded[1]} leaves into model '{mcfg.name}' — "
                 "wrong --model or --num-classes for this checkpoint?")
-        print(f"[predict] restored {mcfg.name}/{track} (saved at epoch "
-              f"{max(0, next_epoch - 1)}, best {best:.2f})")
+        # last_restore_meta carries the SAVED (epoch, step_in_epoch)
+        # regardless of which restore branch ran (next_epoch is
+        # saved_epoch+1 for end-of-epoch checkpoints but the same epoch
+        # for mid-epoch preemption flushes — not invertible here).
+        meta = getattr(mgr, "last_restore_meta", None)
+        if meta is not None:
+            saved_epoch, sie = meta
+            saved_at = (f"epoch {saved_epoch} step {sie}" if sie >= 0
+                        else f"epoch {saved_epoch}")
+        else:
+            saved_at = f"epoch {max(0, next_epoch - 1)}"
+        print(f"[predict] restored {mcfg.name}/{track} (saved at "
+              f"{saved_at}, best {best:.2f})")
 
     # One up-front transfer: the lenient-restore path leaves host numpy
     # leaves, which a jitted call would re-upload every batch. EMA-trained

@@ -183,6 +183,9 @@ class Trainer:
         self.preemption = PreemptionGuard()
         self.logger = MetricLogger(log_dir)
         self.start_epoch = 0
+        # Step offset into start_epoch (step-exact resume from a mid-epoch
+        # preemption flush); 0 for normal end-of-epoch checkpoints.
+        self.start_step = 0
         self.best_score = 0.0
         if cfg.run.init_from:
             self._init_from_torch(cfg.run.init_from)
@@ -191,6 +194,29 @@ class Trainer:
             # resumes at the last periodic save instead of replaying epochs.
             self.state, self.start_epoch, self.best_score = \
                 self.ckpt.restore_into(self.state)
+            self.start_step = (self.ckpt.last_restore_step_in_epoch or 0)
+            if self.start_step:
+                saved = self.ckpt.last_restore_geometry
+                live = self._loader_geometry()
+                if saved is not None and any(
+                        a not in (-1, b) for a, b in zip(saved, live)):
+                    # The epoch permutation is keyed by (seed, n_samples)
+                    # and sliced by global_batch — a mismatch in any means
+                    # the offset points at different samples.
+                    host0_print(
+                        f"[ckpt] mid-epoch checkpoint was flushed under "
+                        f"loader geometry (global_batch, seed, n_samples)="
+                        f"{saved} but this run has {live} — the step "
+                        f"offset would skip the wrong samples; replaying "
+                        f"epoch {self.start_epoch} from its start instead")
+                    self.start_step = 0
+                elif self.start_step > len(self.train_loader):
+                    host0_print(
+                        f"[ckpt] mid-epoch step {self.start_step} exceeds "
+                        f"this run's {len(self.train_loader)} steps/epoch "
+                        f"(dataset changed?) — replaying epoch "
+                        f"{self.start_epoch} from its start instead")
+                    self.start_step = 0
             if self.state_sharding is not None:
                 from tpuic.parallel.sharding import shard_state
                 self.state = shard_state(self.state, self.state_sharding)
@@ -210,12 +236,28 @@ class Trainer:
             from tpuic.parallel.sharding import shard_state
             self.state = shard_state(self.state, self.state_sharding)
 
+    def _loader_geometry(self):
+        """(global_batch, seed, n_samples) — everything the epoch
+        permutation and its step slicing depend on; recorded at a
+        mid-epoch flush and required to match before a resume reuses the
+        step offset."""
+        ld = self.train_loader
+        return (ld.global_batch, ld.seed, len(ld.dataset))
+
     # -- epochs -------------------------------------------------------------
-    def train_epoch(self, epoch: int) -> float:
-        """Reference train_epoch (train.py:36-73)."""
+    def train_epoch(self, epoch: int, start_step: int = 0) -> float:
+        """Reference train_epoch (train.py:36-73).
+
+        ``start_step`` continues a partially-trained epoch at that step
+        (step-exact resume; the loader serves the identical remainder).
+        ``self.last_epoch_steps`` records how many steps of this epoch are
+        complete when the method returns — = steps_per_epoch normally,
+        less if preemption broke the loop — for the mid-epoch flush."""
         losses = AverageMeter()
-        it = self.train_loader.epoch(epoch)
-        bar = tqdm(it, total=len(self.train_loader), disable=not is_host0())
+        remaining = len(self.train_loader) - start_step
+        self.last_epoch_steps = start_step
+        it = self.train_loader.epoch(epoch, start_step=start_step)
+        bar = tqdm(it, total=remaining, disable=not is_host0())
         metrics = None
         log_every = max(1, self.cfg.run.log_every_steps)
         global_batch = self.train_loader.global_batch
@@ -256,6 +298,7 @@ class Trainer:
                 break
             self.state, metrics = self.train_step(
                 self.state, {k: batch[k] for k in ("image", "label", "mask")})
+            self.last_epoch_steps = start_step + step + 1
             if (step + 1) % log_every == 0:
                 handles = {"loss": metrics["loss"],
                            "accuracy": metrics["accuracy"]}
@@ -269,7 +312,7 @@ class Trainer:
                 if pending is not None:
                     self._drain_train_log(pending, losses, bar, epoch)
                 pending = (step0 + step + 1, imgs_per_sec, handles)
-                if step + 1 == len(self.train_loader):
+                if step + 1 == remaining:
                     # Last step of the epoch: drain NOW, while the bar is
                     # still open (set_description on a closed bar is a
                     # no-op), so the final interval's loss is shown. The
@@ -411,7 +454,9 @@ class Trainer:
                     jax.profiler.start_trace(self.cfg.run.profile_dir)
                     profiled = True
                 t0 = time.time()
-                self.train_epoch(epoch)
+                self.train_epoch(
+                    epoch,
+                    self.start_step if epoch == self.start_epoch else 0)
                 # Epoch end is a common boundary: agree so a host whose
                 # local SIGTERM missed the last in-epoch sync point doesn't
                 # diverge from the others (val vs flush).
@@ -422,12 +467,22 @@ class Trainer:
                         jax.profiler.stop_trace()
                         profiled = False
                     # Grace windows are short: skip val and flush 'latest'.
-                    # Saved as epoch-1 so resume (restore_into returns
-                    # saved+1) replays the interrupted epoch rather than
-                    # skipping its unseen tail.
+                    # The save carries the completed step count so resume
+                    # continues the epoch exactly where it stopped (no
+                    # replayed prefix, no skipped tail). A boundary flush
+                    # (done == total) records the full count: resume then
+                    # trains ZERO remaining steps and runs the epoch's
+                    # still-pending validation — so val/save_best are never
+                    # lost to a signal landing between train and val.
+                    done = self.last_epoch_steps
+                    total = len(self.train_loader)
                     host0_print(f"[preempt] signal received during epoch "
-                                f"{epoch}; flushing latest and exiting")
-                    self.ckpt.save_latest(self.state, epoch - 1, best)
+                                f"{epoch} (step {done}/{total}); flushing "
+                                f"latest and exiting")
+                    gb, seed, n = self._loader_geometry()
+                    self.ckpt.save_latest(
+                        self.state, epoch, best, step_in_epoch=done,
+                        global_batch=gb, data_seed=seed, data_len=n)
                     break
                 score = self.val_epoch(epoch)
                 host0_print(f"Epoch {epoch} took {time.time() - t0:.1f}s")
