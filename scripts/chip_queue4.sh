@@ -17,5 +17,11 @@ python scripts/long_seq_bench.py --sizes 1024 --batch 16 --remat \
 
 python bench.py 2>&1 | tail -2 || failures=$((failures+1))
 
+# 3. ViT-L/16 MFU: wider matmuls (1024 hidden / 4096 mlp) should sit
+#    closer to the MXU roof than ViT-B's 0.537 — the scaling datapoint
+#    for the 0.70-north-star frontier (PERF_ANALYSIS.md §10f).
+python scripts/perf_sweep.py --batches 16,32,64 --model vit-l16 \
+  --out perf/vitl_sweep.json 2>&1 | tail -4 || failures=$((failures+1))
+
 echo "chip_queue4: $failures item(s) failed"
 exit $failures
