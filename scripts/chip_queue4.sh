@@ -23,5 +23,22 @@ python bench.py 2>&1 | tail -2 || failures=$((failures+1))
 python scripts/perf_sweep.py --batches 16,32,64 --model vit-l16 \
   --out perf/vitl_sweep.json 2>&1 | tail -4 || failures=$((failures+1))
 
+# 4. Lane-packed flash layout: first Mosaic execution (interpret-mode is
+#    bitwise vs the folded kernel; the 4D grid + leading-dim-2 lse blocks
+#    are the chip risk). Smoke first, then the A/B at the ViT-B b64 train
+#    step and the long-N row where the 2x layout saving matters most.
+#    TPUIC_FLASH_PACKED=0 is the escape hatch if Mosaic rejects it.
+python scripts/pallas_smoke.py 2>&1 | tail -3 || failures=$((failures+1))
+python scripts/packed_valid_smoke.py 2>&1 | tail -2 || failures=$((failures+1))
+TPUIC_FLASH_PACKED=0 python scripts/perf_sweep.py --batches 64 \
+  --model vit-b16 --attention flash \
+  --out perf/vit_flash_folded.json 2>&1 | tail -3 || failures=$((failures+1))
+python scripts/perf_sweep.py --batches 64 --model vit-b16 \
+  --attention flash \
+  --out perf/vit_flash_packed.json 2>&1 | tail -3 || failures=$((failures+1))
+python scripts/long_seq_bench.py --sizes 768 --batch 16 --remat \
+  --remat-policy blocks \
+  --out perf/long_seq_2305_packed.json 2>&1 | tail -4 || failures=$((failures+1))
+
 echo "chip_queue4: $failures item(s) failed"
 exit $failures

@@ -103,6 +103,105 @@ class TestFlashAttention:
         assert biggest <= b * n * h * d, (
             f"residual of {biggest} elements suggests an O(N^2) save")
 
+    @pytest.mark.parametrize("n", [197, 130])  # 197: ViT-B; both pad
+    def test_packed_layout_matches_folded_bitwise(self, n):
+        """The lane-packed variant (kernel I/O in the model's natural
+        [B, N, H*64] layout — no 2x lane-padding expansion, no transpose
+        copies; PERF_ANALYSIS.md §10f) must be BITWISE the folded kernel:
+        same dots in the same order, only the memory layout differs.
+        Covers forward, lse residual, and all three gradients."""
+        import importlib
+        fa = importlib.import_module("tpuic.kernels.flash_attention")
+        b, h, d = 2, 4, 64
+        assert fa._use_packed(h, d)
+        q, k, v = (_rand(i + 50, (b, n, h, d)) for i in range(3))
+        bq, bk = fa._resolve_blocks(n, None, None)
+        out_p, lse_p = fa._flash_fwd_packed(q, k, v, bq, bk, True,
+                                            with_lse=True)
+        out_f, lse_f = fa._flash_fwd(q, k, v, bq, bk, True, with_lse=True)
+        np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_f))
+        np.testing.assert_array_equal(np.asarray(lse_p), np.asarray(lse_f))
+        g = _rand(99, (b, n, h, d))
+        grads_p = fa._flash_bwd_packed(q, k, v, out_p, lse_p, g, bq, bk, True)
+        grads_f = fa._flash_bwd(q, k, v, out_f, lse_f, g, bq, bk, True)
+        for a, b_ in zip(grads_p, grads_f):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    def test_packed_dispatch_gradients_match_dense(self):
+        """The public flash_attention dispatches to the packed variant at
+        head_dim 64 / even heads; end-to-end custom-vjp gradients must
+        match dense (and the non-qualifying vit-tiny-like head_dim 16
+        falls back to the folded path — covered by every other test in
+        this class)."""
+        b, n, h, d = 2, 70, 2, 64
+        q, k, v = (_rand(i + 60, (b, n, h, d)) for i in range(3))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                   float(_dense_loss(q, k, v)), rtol=1e-4)
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(_dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_packed_honors_static_valid(self):
+        """valid_len (the ulysses caller-side token padding) must mask the
+        same keys in the packed variant: attention over the first
+        ``valid`` tokens only, identical to dense on the valid slice."""
+        b, n, h, d, valid = 1, 64, 2, 64, 50
+        q, k, v = (_rand(i + 70, (b, n, h, d)) for i in range(3))
+        got = flash_attention(q, k, v, valid_len=valid)
+        want = _dense_attention(q[:, :valid], k[:, :valid], v[:, :valid])
+        np.testing.assert_allclose(np.asarray(got[:, :valid]),
+                                   np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("narrow", ["v", "k"])
+    def test_packed_mixed_dtype_cotangents(self, narrow):
+        """The packed dk/dv ride ONE kernel output; each half must come
+        back in its own operand's dtype (custom_vjp cotangent check) AND
+        at its own operand's precision — the shared output uses the
+        WIDEST of the two dtypes so neither gradient is quantized through
+        the other's width."""
+        b, n, h, d = 1, 16, 2, 64
+        q, k, v = (_rand(i + 90, (b, n, h, d)) for i in range(3))
+        if narrow == "v":
+            v = v.astype(jnp.bfloat16)
+        else:
+            k = k.astype(jnp.bfloat16)
+        grads = jax.grad(
+            lambda *a: jnp.sum(flash_attention(*a).astype(jnp.float32) ** 2),
+            (0, 1, 2))(q, k, v)
+        assert grads[1].dtype == k.dtype
+        assert grads[2].dtype == v.dtype
+        assert all(bool(jnp.isfinite(g.astype(jnp.float32)).all())
+                   for g in grads)
+        # Precision pin for the WIDE operand's gradient: bitwise equal to
+        # the folded path on the same inputs.
+        import importlib
+        fa = importlib.import_module("tpuic.kernels.flash_attention")
+        bq, bk = fa._resolve_blocks(n, None, None)
+        out, lse = fa._flash_fwd_packed(q, k, v, bq, bk, True, with_lse=True)
+        g = jnp.ones((b, n, h, d), q.dtype)
+        packed = fa._flash_bwd_packed(q, k, v, out, lse, g, bq, bk, True)
+        folded = fa._flash_bwd(q, k, v, out, lse, g, bq, bk, True)
+        wide = 1 if narrow == "v" else 2   # dk wide when v narrow, etc.
+        np.testing.assert_array_equal(np.asarray(packed[wide]),
+                                      np.asarray(folded[wide]))
+
+    def test_packed_kill_switch(self, monkeypatch):
+        """TPUIC_FLASH_PACKED=0 forces the folded path (chip-side escape
+        hatch if Mosaic rejects the 4D-grid packed lowering)."""
+        import importlib
+        fa = importlib.import_module("tpuic.kernels.flash_attention")
+        assert fa._use_packed(4, 64)
+        monkeypatch.setenv("TPUIC_FLASH_PACKED", "0")
+        assert not fa._use_packed(4, 64)
+        assert not fa._use_packed(3, 64)  # odd heads never pack
+        assert not fa._use_packed(4, 16)  # head_dim 16 never packs
+
     def test_bf16_stays_finite(self):
         b, n, h, d = 1, 16, 2, 8
         q, k, v = (20.0 * _rand(i, (b, n, h, d)).astype(jnp.bfloat16)
@@ -180,6 +279,27 @@ class TestKernelWiring:
         b = flash.apply(v, x, train=False)  # same params: only attn differs
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_flash_vit_s16_matches_dense_vit_packed_path(self):
+        """vit-s16 has head_dim 64 / 6 heads — the shapes the lane-packed
+        kernel dispatch covers (vit-tiny's head_dim 16 exercises the
+        folded fallback above)."""
+        import sys
+        from tpuic.models import create_model
+
+        fa = sys.modules["tpuic.kernels.flash_attention"]
+        assert fa._use_packed(6, 64)
+        dense = create_model("vit-s16", 5, dtype="float32",
+                             attention="dense")
+        flash = create_model("vit-s16", 5, dtype="float32",
+                             attention="flash")
+        v = dense.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)),
+                       train=False)
+        x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+        a = dense.apply(v, x, train=False)
+        b = flash.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
     def test_sharded_train_step_with_flash_and_fused_loss(self):
         from jax.sharding import NamedSharding, PartitionSpec as P

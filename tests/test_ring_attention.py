@@ -103,6 +103,29 @@ class TestRingFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("n", [16, 5])  # 5: fully-padded ring block
+    def test_packed_kernel_path_matches_dense(self, devices8, n):
+        """head_dim 64 / even heads routes each ring step through the
+        lane-packed kernels (natural [B, N, H*64] I/O) — the only caller
+        of their dynamic ``valid`` SMEM scalar and -inf masked_sentinel,
+        so this pins that path fwd AND bwd."""
+        import importlib
+        fa = importlib.import_module("tpuic.kernels.flash_attention")
+        b, h, d = 1, 2, 64
+        assert fa._use_packed(h, d)
+        mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+        q, k, v = (_rand(i + 80, (b, n, h, d)) for i in range(3))
+        got = ring_flash_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dense(q, k, v)),
+                                   rtol=1e-4, atol=1e-4)
+        g1 = jax.grad(lambda *a: jnp.sum(ring_flash_attention(*a, mesh) ** 2),
+                      (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(_dense(*a) ** 2), (0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_missing_seq_axis_raises(self, devices8):
         mesh = jax.sharding.Mesh(np.asarray(devices8).reshape(8, 1),
                                  ("data", "model"))

@@ -51,11 +51,17 @@ _LANES = 128
 _DIM_SEMANTICS = ("parallel", "parallel", "arbitrary")
 
 
-def _compiler_params():
+def _compiler_params(semantics=_DIM_SEMANTICS):
     try:
-        return pltpu.CompilerParams(dimension_semantics=_DIM_SEMANTICS)
+        return pltpu.CompilerParams(dimension_semantics=semantics)
     except (AttributeError, TypeError):  # older pallas naming
-        return pltpu.TPUCompilerParams(dimension_semantics=_DIM_SEMANTICS)
+        return pltpu.TPUCompilerParams(dimension_semantics=semantics)
+
+
+# Packed grids are (batch, head-pair, own-block, reduction).
+def _compiler_params_4d():
+    return _compiler_params(("parallel", "parallel", "parallel",
+                             "arbitrary"))
 
 
 def _dot_precision(dtype) -> jax.lax.Precision:
@@ -73,6 +79,76 @@ def _f32_for(ref_dtype, x):
     """Softmax-side f32 view of a probability tile, cast back to the
     operand dtype only when the MXU pass is narrow anyway."""
     return x.astype(ref_dtype) if ref_dtype != jnp.float32 else x
+
+
+def _fwd_tile(q_t, k_t, v_t, kpos, vl, m, l, acc, *, scale, prec, dt):
+    """One (q-tile, k-tile) online-softmax update — the single copy of the
+    forward tile math shared by the folded and lane-packed kernels.
+    Returns (m_new, l_new, acc_new)."""
+    s = jax.lax.dot_general(q_t, k_t, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale          # [bq, bk]
+    s = jnp.where(kpos < vl, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.dot(_f32_for(dt, p), v_t,
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+    return m_new, l_new, acc_new
+
+
+def _finish_tile(m, l, acc, masked_sentinel):
+    """(o_tile_f32, lse_row) from the final online-softmax state; fully
+    masked rows get ``masked_sentinel`` (see _fwd_kernel docstring)."""
+    o = acc / jnp.maximum(l, 1e-30)
+    lse = jnp.where(m[:, 0] > _NEG_INF / 2,
+                    m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
+                    masked_sentinel)
+    return o, lse
+
+
+def _bwd_dq_tile(q_t, k_t, v_t, do_t, lse, delta, kpos, vl, *, scale, prec,
+                 dt):
+    """dq increment for one (q-tile, k-tile): ds @ k (the caller applies
+    the final ``scale``). Shared by folded and packed dq kernels."""
+    s = scale * jax.lax.dot_general(q_t, k_t, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+    s = jnp.where(kpos < vl, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do_t, v_t, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+    ds = p * (dp - delta)
+    return jnp.dot(_f32_for(dt, ds), k_t, preferred_element_type=jnp.float32,
+                   precision=prec)
+
+
+def _bwd_dkv_tile(q_t, k_t, v_t, do_t, lse, delta, kpos, vl, *, scale, prec,
+                  dt):
+    """(dk_increment_unscaled, dv_increment) for one (k-tile, q-tile) —
+    the caller applies ``scale`` to dk. Shared by folded and packed
+    dk/dv kernels."""
+    s = scale * jax.lax.dot_general(q_t, k_t, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+    s = jnp.where(kpos < vl, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    dv_inc = jax.lax.dot_general(_f32_for(dt, p), do_t,
+                                 (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
+    dp = jax.lax.dot_general(do_t, v_t, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+    ds = p * (dp - delta)
+    dk_inc = jax.lax.dot_general(_f32_for(dt, ds), q_t,
+                                 (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
+    return dk_inc, dv_inc
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, m_s, l_s,
@@ -103,47 +179,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, m_s, l_s,
 
     dt = q_ref.dtype
     prec = _dot_precision(dt)
-    # Contract in the operands' stored dtype (bf16 stays one native MXU
-    # pass; f32 runs HIGHEST — see _dot_precision); scale the f32 result.
-    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                            precision=prec) * scale      # [bq, bk]
-    bq = s.shape[0]
+    bq = q_ref.shape[1]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
     vl = valid_len if valid_ref is None else valid_ref[0]
-    s = jnp.where(kpos < vl, s, _NEG_INF)
-
-    m = m_s[:, :1]                                       # [bq, 1]
-    l = l_s[:, :1]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_s[...] = acc_s[...] * alpha + jnp.dot(
-        _f32_for(dt, p), v_ref[0], preferred_element_type=jnp.float32,
-        precision=prec)
+    m_new, l_new, acc_new = _fwd_tile(
+        q_ref[0], k_ref[0], v_ref[0], kpos, vl,
+        m_s[:, :1], l_s[:, :1], acc_s[...], scale=scale, prec=prec, dt=dt)
+    acc_s[...] = acc_new
     m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
-    l_s[...] = jnp.broadcast_to(l, l_s.shape)
+    l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
 
     @pl.when(ki == n_k_blocks - 1)
     def _finish():
-        lf = l_s[:, :1]
-        mf = m_s[:, :1]
-        o_ref[0] = (acc_s[...] / jnp.maximum(lf, 1e-30)).astype(o_ref.dtype)
+        o, lse = _finish_tile(m_s[:, :1], l_s[:, :1], acc_s[...],
+                              masked_sentinel)
+        o_ref[0] = o.astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp per query row, the only softmax residual the backward
-            # needs. Fully-masked rows get ``masked_sentinel`` (see
-            # docstring). lse blocks are [1, 1, block_q]: row vectors must
+            # needs. lse blocks are [1, 1, block_q]: row vectors must
             # keep a unit second-minor dim — Mosaic requires the last two
             # block dims to be (mult of 8, mult of 128) OR equal to the
             # array dims, which a [1, block_q] block of a 2D array violates
             # (surfaced on real TPU, round-3 smoke; interpret mode did
             # not enforce it).
-            lse_ref[0, 0] = jnp.where(
-                mf[:, 0] > _NEG_INF / 2,
-                mf[:, 0] + jnp.log(jnp.maximum(lf[:, 0], 1e-30)),
-                masked_sentinel)
+            lse_ref[0, 0] = lse
 
 
 def _pad_seq(t: jnp.ndarray, to: int) -> jnp.ndarray:
@@ -296,25 +356,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, valid_ref,
 
     dt = q_ref.dtype
     prec = _dot_precision(dt)
-    lse = lse_ref[0, 0][:, None]                         # [bq, 1]
-    delta = delta_ref[0, 0][:, None]
-    s = scale * jax.lax.dot_general(q_ref[0], k_ref[0],
-                                    (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32,
-                                    precision=prec)
-    bq = s.shape[0]
+    bq = q_ref.shape[1]
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (bq, block_k), 1)
     vl = valid_len if valid_ref is None else valid_ref[0]
-    s = jnp.where(kpos < vl, s, _NEG_INF)
-    p = jnp.exp(s - lse)                                 # [bq, bk]
-    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=prec)
-    ds = p * (dp - delta)
-    acc_s[...] += jnp.dot(_f32_for(dt, ds), k_ref[0],
-                          preferred_element_type=jnp.float32,
-                          precision=prec)
+    acc_s[...] += _bwd_dq_tile(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+        lse_ref[0, 0][:, None], delta_ref[0, 0][:, None], kpos, vl,
+        scale=scale, prec=prec, dt=dt)
 
     @pl.when(ki == n_k_blocks - 1)
     def _finish():
@@ -338,28 +387,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bk = k_ref.shape[1]
     j = pl.program_id(1)
     kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # [1, bk]
-
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
-    s = scale * jax.lax.dot_general(q_ref[0], k_ref[0],
-                                    (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32,
-                                    precision=prec)
     vl = valid_len if valid_ref is None else valid_ref[0]
-    s = jnp.where(kpos < vl, s, _NEG_INF)                # [bq, bk]
-    p = jnp.exp(s - lse)
-    dv_s[...] += jax.lax.dot_general(_f32_for(dt, p), do_ref[0],
-                                     (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32,
-                                     precision=prec)
-    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=prec)
-    ds = p * (dp - delta)                                # [bq, bk]
-    dk_s[...] += scale * jax.lax.dot_general(
-        _f32_for(dt, ds), q_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec)
+    dk_inc, dv_inc = _bwd_dkv_tile(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+        lse_ref[0, 0][:, None], delta_ref[0, 0][:, None], kpos, vl,
+        scale=scale, prec=prec, dt=dt)
+    dv_s[...] += dv_inc
+    dk_s[...] += scale * dk_inc
 
     @pl.when(qi_idx == n_q_blocks - 1)
     def _finish():
@@ -465,6 +499,360 @@ def _flash_bwd(q, k, v, o, lse, do, block_q: int, block_k: int,
             _unfold(dv, b, h, n, d))
 
 
+# -- lane-packed variant ----------------------------------------------------
+#
+# The folded layout above reshapes [B, N, H, 64] to [B*H, N, 64]: a minor
+# dim of 64 under the TPU's (8, 128) tiled layout pads every lane row to
+# 128, so each q/k/v/o HBM array allocates 2x its bytes (seen directly in
+# the N=4097 OOM dump, PERF_ANALYSIS.md §10f), and the fold itself is a
+# transpose copy. The packed variant keeps kernel I/O in the model's
+# NATURAL [B, N, H*64] layout — [B, N, H, D] -> [B, N, H*D] is a free
+# contiguous reshape, the minor dim is 128-aligned (no tiling waste, no
+# transpose), and the grid gains a head-pair axis: each program loads one
+# 128-lane block holding TWO heads and runs both 64-wide online softmaxes.
+# lse/delta keep the legacy [B*H, 1, N_padded] layout via leading-dim-2
+# blocks (the (8,128) rule constrains only the last two block dims), so
+# residual formats are identical across variants. Dispatched automatically
+# for head_dim 64 + even head count (the whole ViT zoo except vit-tiny)
+# from BOTH the public flash_attention custom-vjp and the ring
+# composition's per-step calls (tpuic/parallel/ring_attention.py — the
+# identical lse format is what makes its cross-block combination
+# layout-agnostic); TPUIC_FLASH_PACKED=0 disables everywhere.
+
+
+def _use_packed(h: int, d: int) -> bool:
+    import os
+    if os.environ.get("TPUIC_FLASH_PACKED", "1") == "0":
+        return False
+    return d == 64 and h % 2 == 0
+
+
+def _select_kernels(h: int, d: int):
+    """(fwd, bwd) implementation pair for these head dims — the ONE place
+    the packed-vs-folded choice is made (public custom-vjp fwd/bwd and
+    both ring_attention impls all call this; fwd and bwd must never come
+    from different variants: their lse padding/layout contract is shared
+    but their dispatch predicate must match)."""
+    if _use_packed(h, d):
+        return _flash_fwd_packed, _flash_bwd_packed
+    return _flash_fwd, _flash_bwd
+
+
+def _fwd_kernel_packed(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
+                       m0_s, l0_s, m1_s, l1_s, acc0_s, acc1_s, *,
+                       block_k: int, d: int, scale: float, valid_len: int,
+                       n_k_blocks: int, masked_sentinel: float):
+    """One (batch, head-pair, q-block, k-block) program: two 64-wide heads
+    share the 128-lane operand block; each keeps its own online-softmax
+    state. Math per head is identical to :func:`_fwd_kernel`."""
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        for m_s, l_s, acc_s in ((m0_s, l0_s, acc0_s), (m1_s, l1_s, acc1_s)):
+            m_s[...] = jnp.full_like(m_s, _NEG_INF)
+            l_s[...] = jnp.zeros_like(l_s)
+            acc_s[...] = jnp.zeros_like(acc_s)
+
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]     # [bq|bk, 2d]
+    bq = q2.shape[0]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    vl = valid_len if valid_ref is None else valid_ref[0]
+
+    for h_i, (m_s, l_s, acc_s) in enumerate(((m0_s, l0_s, acc0_s),
+                                             (m1_s, l1_s, acc1_s))):
+        lo = h_i * d
+        m_new, l_new, acc_new = _fwd_tile(
+            q2[:, lo:lo + d], k2[:, lo:lo + d], v2[:, lo:lo + d], kpos, vl,
+            m_s[:, :1], l_s[:, :1], acc_s[...], scale=scale, prec=prec,
+            dt=dt)
+        acc_s[...] = acc_new
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _finish():
+        halves = []
+        for h_i, (m_s, l_s, acc_s) in enumerate(((m0_s, l0_s, acc0_s),
+                                                 (m1_s, l1_s, acc1_s))):
+            o, lse = _finish_tile(m_s[:, :1], l_s[:, :1], acc_s[...],
+                                  masked_sentinel)
+            halves.append(o)
+            if lse_ref is not None:
+                lse_ref[h_i, 0] = lse
+        o_ref[0] = jnp.concatenate(halves, axis=-1).astype(o_ref.dtype)
+
+
+def _pack(t, b, n, h, d, n_padded):  # [B,N,H,D] -> [B, N_padded, H*D]
+    return _pad_seq(t.reshape(b, n, h * d), n_padded)
+
+
+def _unpack(t, b, h, n, d):  # [B, N_padded, H*D] -> [B,N,H,D]
+    return t[:, :n].reshape(b, n, h, d)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret", "with_lse",
+                                             "masked_sentinel",
+                                             "static_valid"))
+def _flash_fwd_packed(q, k, v, block_q: int, block_k: int, interpret: bool,
+                      with_lse: bool = False, valid=None,
+                      masked_sentinel: float = 0.0, static_valid=None):
+    """Packed-layout forward: same contract as :func:`_flash_fwd` (lse, when
+    requested, in the identical [B*H, 1, N_padded] layout)."""
+    b, n, h, d = q.shape
+    hp = h // 2
+    valid_len = n if static_valid is None else static_valid
+    scale = 1.0 / (d ** 0.5)
+    n_padded = _padded_len(n, block_q, block_k)
+
+    qp = _pack(q, b, n, h, d, n_padded)
+    kp = _pack(k, b, n, h, d, n_padded)
+    vp = _pack(v, b, n, h, d, n_padded)
+    n_k_blocks = n_padded // block_k
+    grid = (b, hp, n_padded // block_q, n_k_blocks)
+    pair = lambda bsz, row: pl.BlockSpec(
+        (1, bsz, 2 * d), lambda bi, hi, j, ki, _r=row: (bi, (j, ki)[_r], hi),
+        memory_space=pltpu.VMEM)
+    in_specs = [pair(block_q, 0), pair(block_k, 1), pair(block_k, 1)]
+    operands = [qp, kp, vp]
+    if valid is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(valid.astype(jnp.int32))
+    out_shape = [jax.ShapeDtypeStruct((b, n_padded, h * d), q.dtype)]
+    out_specs = [pair(block_q, 0)]
+    if with_lse:
+        # Legacy lse layout; this program owns rows (b*h + 2*hi, +1) of
+        # dim 0 — a leading block dim of 2, index b*hp + hi in block
+        # units. Last two block dims stay (1, block_q): TPU-legal.
+        out_shape.append(jax.ShapeDtypeStruct((b * h, 1, n_padded),
+                                              jnp.float32))
+        out_specs.append(pl.BlockSpec((2, 1, block_q),
+                                      lambda bi, hi, j, ki: (bi * hp + hi,
+                                                             0, j),
+                                      memory_space=pltpu.VMEM))
+
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        valid_ref, rest = ((rest[0], rest[1:]) if valid is not None
+                           else (None, rest))
+        o_ref = rest[0]
+        lse_ref = rest[1] if with_lse else None
+        scratch = rest[2:] if with_lse else rest[1:]
+        _fwd_kernel_packed(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
+                           *scratch, block_k=block_k, d=d, scale=scale,
+                           valid_len=valid_len, n_k_blocks=n_k_blocks,
+                           masked_sentinel=masked_sentinel)
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, _LANES), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params_4d(),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * n_padded * n_padded * d,
+            bytes_accessed=3 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(*operands)
+    out = _unpack(res[0], b, h, n, d)
+    if with_lse:
+        return out, res[1]
+    return out
+
+
+def _bwd_dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          valid_ref, dq_ref, acc0_s, acc1_s, *, block_k: int,
+                          d: int, scale: float, valid_len: int,
+                          n_k_blocks: int):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc0_s[...] = jnp.zeros_like(acc0_s)
+        acc1_s[...] = jnp.zeros_like(acc1_s)
+
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    bq = q2.shape[0]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    vl = valid_len if valid_ref is None else valid_ref[0]
+
+    for h_i, acc_s in enumerate((acc0_s, acc1_s)):
+        lo = h_i * d
+        acc_s[...] += _bwd_dq_tile(
+            q2[:, lo:lo + d], k2[:, lo:lo + d], v2[:, lo:lo + d],
+            do2[:, lo:lo + d], lse_ref[h_i, 0][:, None],
+            delta_ref[h_i, 0][:, None], kpos, vl, scale=scale, prec=prec,
+            dt=dt)
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = jnp.concatenate(
+            [scale * acc0_s[...], scale * acc1_s[...]],
+            axis=-1).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           valid_ref, dkv_ref, dk0_s, dv0_s, dk1_s, dv1_s,
+                           *, block_q: int, d: int, scale: float,
+                           valid_len: int, n_q_blocks: int):
+    qi_idx = pl.program_id(3)
+
+    @pl.when(qi_idx == 0)
+    def _init():
+        for s_ in (dk0_s, dv0_s, dk1_s, dv1_s):
+            s_[...] = jnp.zeros_like(s_)
+
+    dt = q_ref.dtype
+    prec = _dot_precision(dt)
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    bk = k2.shape[0]
+    j = pl.program_id(2)
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    vl = valid_len if valid_ref is None else valid_ref[0]
+
+    for h_i, (dk_s, dv_s) in enumerate(((dk0_s, dv0_s), (dk1_s, dv1_s))):
+        lo = h_i * d
+        dk_inc, dv_inc = _bwd_dkv_tile(
+            q2[:, lo:lo + d], k2[:, lo:lo + d], v2[:, lo:lo + d],
+            do2[:, lo:lo + d], lse_ref[h_i, 0][:, None],
+            delta_ref[h_i, 0][:, None], kpos, vl, scale=scale, prec=prec,
+            dt=dt)
+        dv_s[...] += dv_inc
+        dk_s[...] += scale * dk_inc
+
+    @pl.when(qi_idx == n_q_blocks - 1)
+    def _finish():
+        # dk and dv ride ONE [., bk, 4d] output (dk pair | dv pair):
+        # separate outputs would be fine too, this just keeps the store
+        # count down.
+        dkv_ref[0] = jnp.concatenate(
+            [dk0_s[...], dk1_s[...], dv0_s[...], dv1_s[...]],
+            axis=-1).astype(dkv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret", "static_valid"))
+def _flash_bwd_packed(q, k, v, o, lse, do, block_q: int, block_k: int,
+                      interpret: bool, valid=None, static_valid=None):
+    """Packed-layout backward: same contract as :func:`_flash_bwd`."""
+    b, n, h, d = q.shape
+    hp = h // 2
+    valid_len = n if static_valid is None else static_valid
+    scale = 1.0 / (d ** 0.5)
+    n_padded = _padded_len(n, block_q, block_k)
+
+    qp, kp, vp, dop = (_pack(t, b, n, h, d, n_padded)
+                       for t in (q, k, v, do))
+    # delta in the legacy [B*H, 1, N_padded] layout, computed from the
+    # unfolded tensors (no folded copies).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = _pad_seq(jnp.transpose(delta, (0, 2, 1)).reshape(b * h, n, 1),
+                     n_padded)[..., 0][:, None, :]
+    n_q_blocks = n_padded // block_q
+    n_k_blocks = n_padded // block_k
+
+    pair = lambda bsz, row: pl.BlockSpec(
+        (1, bsz, 2 * d), lambda bi, hi, j, r, _r=row: (bi, (j, r)[_r], hi),
+        memory_space=pltpu.VMEM)
+    lse_own = pl.BlockSpec((2, 1, block_q),
+                           lambda bi, hi, j, r: (bi * hp + hi, 0, j),
+                           memory_space=pltpu.VMEM)
+    lse_red = pl.BlockSpec((2, 1, block_q),
+                           lambda bi, hi, j, r: (bi * hp + hi, 0, r),
+                           memory_space=pltpu.VMEM)
+    operands = [qp, kp, vp, dop, lse, delta]
+    extra_specs = []
+    if valid is not None:
+        operands.append(valid.astype(jnp.int32))
+        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+
+    def _dq_kernel(*refs):
+        if valid is not None:
+            *ins, valid_ref, dq_ref, acc0, acc1 = refs
+        else:
+            *ins, dq_ref, acc0, acc1 = refs
+            valid_ref = None
+        _bwd_dq_kernel_packed(*ins, valid_ref, dq_ref, acc0, acc1,
+                              block_k=block_k, d=d, scale=scale,
+                              valid_len=valid_len, n_k_blocks=n_k_blocks)
+
+    dq = pl.pallas_call(
+        _dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_padded, h * d), q.dtype),
+        grid=(b, hp, n_q_blocks, n_k_blocks),
+        in_specs=[pair(block_q, 0), pair(block_k, 1), pair(block_k, 1),
+                  pair(block_q, 0), lse_own, lse_own] + extra_specs,
+        out_specs=pair(block_q, 0),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params_4d(),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * h * n_padded * n_padded * d,
+            bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(*operands)
+
+    def _dkv_kernel(*refs):
+        if valid is not None:
+            *ins, valid_ref, dkv_ref, dk0, dv0, dk1, dv1 = refs
+        else:
+            *ins, dkv_ref, dk0, dv0, dk1, dv1 = refs
+            valid_ref = None
+        _bwd_dkv_kernel_packed(*ins, valid_ref, dkv_ref, dk0, dv0, dk1, dv1,
+                               block_q=block_q, d=d, scale=scale,
+                               valid_len=valid_len, n_q_blocks=n_q_blocks)
+
+    dkv_spec = pl.BlockSpec((1, block_k, 4 * d),
+                            lambda bi, hi, j, r: (bi, j, hi),
+                            memory_space=pltpu.VMEM)
+    # The single dkv output must not quantize EITHER gradient: use the
+    # widest of the two operand dtypes and cast the halves back after the
+    # unscramble (mixed dtypes are rare; same-dtype calls pay nothing).
+    dkv_dtype = jnp.result_type(k.dtype, v.dtype)
+    dkv = pl.pallas_call(
+        _dkv_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_padded, 2 * h * d), dkv_dtype),
+        grid=(b, hp, n_k_blocks, n_q_blocks),
+        in_specs=[pair(block_q, 1), pair(block_k, 0), pair(block_k, 0),
+                  pair(block_q, 1), lse_red, lse_red] + extra_specs,
+        out_specs=dkv_spec,
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params_4d(),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * h * n_padded * n_padded * d,
+            bytes_accessed=4 * b * h * n_padded * d * q.dtype.itemsize,
+            transcendentals=b * h * n_padded * n_padded),
+    )(*operands)
+    # dkv: [B, N_padded, 2*H*D] laid out as per-pair [dk0|dk1|dv0|dv1].
+    # Halves come back in their own operand dtypes (custom_vjp requires
+    # cotangent dtype == primal dtype); dkv_dtype above guarantees the
+    # cast never LOSES precision relative to the folded variant's
+    # separate out_shapes.
+    dkv = dkv[:, :n].reshape(b, n, hp, 4, d)
+    dk = dkv[:, :, :, :2].reshape(b, n, h, d).astype(k.dtype)
+    dv = dkv[:, :, :, 2:].reshape(b, n, h, d).astype(v.dtype)
+    return _unpack(dq, b, h, n, d), dk, dv
+
+
 def _shard_batch(mesh: Optional[Mesh], b: int) -> bool:
     """True when the kernel should run under shard_map over the data axis."""
     if mesh is None or "data" not in mesh.axis_names:
@@ -486,9 +874,10 @@ def flash_attention(q, k, v, block_q: Optional[int] = None,
     jit (see module docstring); ``valid_len`` masks keys beyond a static
     count when the inputs carry caller-side padding (ulysses)."""
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    fwd, _ = _select_kernels(q.shape[2], q.shape[3])
     return _batch_parallel(
-        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
-                                        static_valid=valid_len),
+        lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
+                                 static_valid=valid_len),
         mesh, interpret, 1, q, k, v)
 
 
@@ -514,10 +903,11 @@ def _batch_parallel(fn, mesh, interpret, n_out, *operands):
 
 def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh, valid_len=None):
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    fwd, _ = _select_kernels(q.shape[2], q.shape[3])
     out, lse = _batch_parallel(
-        lambda interp, *ops: _flash_fwd(*ops, block_q, block_k, interp,
-                                        with_lse=True,
-                                        static_valid=valid_len),
+        lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
+                                 with_lse=True,
+                                 static_valid=valid_len),
         mesh, interpret, 2, q, k, v)
     return out, (q, k, v, out, lse)
 
@@ -526,9 +916,10 @@ def _vjp_bwd(block_q, block_k, interpret, mesh, valid_len, res, g):
     q, k, v, out, lse = res
     # Same resolution as the forward: lse was padded with these blocks.
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    _, bwd = _select_kernels(q.shape[2], q.shape[3])
     return _batch_parallel(
-        lambda interp, *ops: _flash_bwd(*ops, block_q, block_k, interp,
-                                        static_valid=valid_len),
+        lambda interp, *ops: bwd(*ops, block_q, block_k, interp,
+                                 static_valid=valid_len),
         mesh, interpret, 3, q, k, v, out, lse, g)
 
 

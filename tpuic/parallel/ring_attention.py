@@ -200,16 +200,19 @@ def _ringflash_local(q, k, v, axis_name, ring_size, n_valid, n_local,
 
 def _ringflash_fwd_impl(q, k, v, axis_name, ring_size, n_valid, n_local,
                         interpret):
-    from tpuic.kernels.flash_attention import (_NEG_INF, _flash_fwd,
-                                               _resolve_blocks)
+    from tpuic.kernels.flash_attention import (_NEG_INF, _resolve_blocks,
+                                               _select_kernels)
     bq, bk = _resolve_blocks(n_local, None, None)
     idx = lax.axis_index(axis_name)
     b, _, h, _ = q.shape
+    # The packed (natural-layout) kernel keeps the folded lse format
+    # exactly, so the ring's cross-block combination is layout-agnostic.
+    fwd, _ = _select_kernels(h, q.shape[-1])
     out = lse = None
     for step in range(ring_size):  # static: unrolled by trace
         valid = _block_valid(idx, step, ring_size, n_valid, n_local)
-        o_i, lse_i = _flash_fwd(q, k, v, bq, bk, interpret, with_lse=True,
-                                valid=valid, masked_sentinel=_NEG_INF)
+        o_i, lse_i = fwd(q, k, v, bq, bk, interpret, with_lse=True,
+                         valid=valid, masked_sentinel=_NEG_INF)
         if out is None:
             out, lse = o_i.astype(jnp.float32), lse_i
         else:
@@ -235,10 +238,12 @@ def _ringflash_vjp_bwd(axis_name, ring_size, n_valid, n_local, interpret,
     """Reverse ring: k/v rotate again, each step runs the blockwise flash
     backward against the GLOBAL (out, lse), and the dk/dv accumulators
     travel with their blocks — after ring_size rotations they are home."""
-    from tpuic.kernels.flash_attention import _flash_bwd, _resolve_blocks
+    from tpuic.kernels.flash_attention import (_resolve_blocks,
+                                               _select_kernels)
     q, k, v, out, lse = res
     kdt, vdt = k.dtype, v.dtype
     bq, bk = _resolve_blocks(n_local, None, None)
+    _, bwd = _select_kernels(q.shape[2], q.shape[3])
     idx = lax.axis_index(axis_name)
     do = g
     dq = jnp.zeros(q.shape, jnp.float32)
@@ -247,8 +252,8 @@ def _ringflash_vjp_bwd(axis_name, ring_size, n_valid, n_local, interpret,
     perm = _ring_perm(ring_size)
     for step in range(ring_size):
         valid = _block_valid(idx, step, ring_size, n_valid, n_local)
-        dq_i, dk_i, dv_i = _flash_bwd(q, k, v, out, lse, do, bq, bk,
-                                      interpret, valid=valid)
+        dq_i, dk_i, dv_i = bwd(q, k, v, out, lse, do, bq, bk,
+                               interpret, valid=valid)
         dq = dq + dq_i.astype(jnp.float32)
         dk = dk + dk_i.astype(jnp.float32)
         dv = dv + dv_i.astype(jnp.float32)
