@@ -49,6 +49,32 @@ def _cfg(root, ckpt, epochs):
     )
 
 
+def _assert_bitwise_resume(make_cfg, tmp_path, trip_offset):
+    """Run straight vs (interrupt at epoch-1 step ``trip_offset`` ->
+    resume) with Trainers built by ``make_cfg(ckpt_dir)``; assert the two
+    end states are bitwise equal (params AND optimizer step)."""
+    straight = Trainer(make_cfg(str(tmp_path / "ck_a")))
+    steps_per_epoch = straight.train_loader.steps_per_epoch()
+    assert steps_per_epoch > trip_offset  # trip lands strictly mid-epoch
+    straight.fit()
+
+    interrupted = Trainer(make_cfg(str(tmp_path / "ck_b")))
+    _trip_after(interrupted, steps_per_epoch + trip_offset)
+    interrupted.fit()
+    resumed = Trainer(make_cfg(str(tmp_path / "ck_b")))
+    assert (resumed.start_epoch, resumed.start_step) == (1, trip_offset)
+    resumed.fit()
+
+    a = jax.device_get(straight.state.params)
+    b = jax.device_get(resumed.state.params)
+    for pa, pb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(straight.state.step)),
+        np.asarray(jax.device_get(resumed.state.step)))
+
+
 def _trip_after(trainer, n_steps):
     """Wrap trainer.train_step to latch preemption after n_steps calls;
     returns the call-count list."""
@@ -103,27 +129,8 @@ def test_interrupted_resume_matches_uninterrupted_run_bitwise(tmp_path):
     # below lands strictly inside epoch 1 (not on its boundary).
     make_synthetic_imagefolder(root, classes=("a", "b"), per_class=24,
                                size=24)
-
-    straight = Trainer(_cfg(root, str(tmp_path / "ck_a"), epochs=2))
-    steps_per_epoch = straight.train_loader.steps_per_epoch()
-    assert steps_per_epoch == 3
-    straight.fit()
-
-    interrupted = Trainer(_cfg(root, str(tmp_path / "ck_b"), epochs=2))
-    _trip_after(interrupted, steps_per_epoch + 2)  # 2 steps into epoch 1
-    interrupted.fit()
-    resumed = Trainer(_cfg(root, str(tmp_path / "ck_b"), epochs=2))
-    assert (resumed.start_epoch, resumed.start_step) == (1, 2)
-    resumed.fit()
-
-    a = jax.device_get(straight.state.params)
-    b = jax.device_get(resumed.state.params)
-    for pa, pb in zip(jax.tree_util.tree_leaves(a),
-                      jax.tree_util.tree_leaves(b)):
-        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(straight.state.step)),
-        np.asarray(jax.device_get(resumed.state.step)))
+    _assert_bitwise_resume(lambda ck: _cfg(root, ck, epochs=2), tmp_path,
+                           trip_offset=2)  # 2 steps into epoch 1
 
 
 def test_preemption_before_first_epoch_resumes_at_zero(tmp_path):
@@ -198,3 +205,27 @@ def test_resume_with_changed_global_batch_replays_epoch(tmp_path):
     resumed = Trainer(cfg2)
     assert resumed.start_epoch == 1   # still the interrupted epoch...
     assert resumed.start_step == 0    # ...but replayed from its start
+
+
+def test_resume_composes_with_flash_and_blocks_remat(tmp_path):
+    """The round's features composed: lane-packed flash attention
+    (vit-s16: head_dim 64) + per-encoder-block remat + step-exact resume.
+    The interrupted+resumed run must still be bitwise the uninterrupted
+    one — custom-vjp kernels under nn.remat under a preemption/restore
+    cycle share no hidden state that could diverge."""
+    import dataclasses
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=24,
+                               size=32)
+
+    def cfg(ckpt):
+        c = _cfg(root, ckpt, epochs=2)
+        return dataclasses.replace(
+            c,
+            data=dataclasses.replace(c.data, resize_size=32),
+            model=dataclasses.replace(c.model, name="vit-s16",
+                                      attention="flash", remat=True,
+                                      remat_policy="blocks"))
+
+    _assert_bitwise_resume(cfg, tmp_path, trip_offset=1)
