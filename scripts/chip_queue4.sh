@@ -11,6 +11,14 @@ set -x -o pipefail
 failures=0
 cd /root/repo
 
+# Don't contend with a driver-run bench/dryrun on the single chip (the
+# poller already waits for pytest; these measurements are the round's
+# record and must not be skewed by queue traffic).
+while pgrep -f "python bench.py|__graft_entry__" > /dev/null; do
+  echo "$(date -u +%FT%TZ) chip_queue4: waiting for bench/dryrun to finish"
+  sleep 60
+done
+
 python scripts/long_seq_bench.py --sizes 1024 --batch 16 --remat \
   --remat-policy blocks \
   --out perf/long_seq_4k_blocks.json 2>&1 | tail -4 || failures=$((failures+1))
