@@ -87,6 +87,16 @@ def test_extended_flags_map_to_config():
     assert not cfg0.optim.fused_loss
 
 
+def test_no_augment_flag():
+    # Default keeps the reference's always-on train-fold chain
+    # (dp/loader.py:63-83); --no-augment turns it off for
+    # orientation-sensitive datasets (digits: rot90/flip alias 6<->9).
+    args = cli.build_parser().parse_args(["--datadir", "/d"])
+    assert cli.config_from_args(args).data.augment is True
+    args = cli.build_parser().parse_args(["--datadir", "/d", "--no-augment"])
+    assert cli.config_from_args(args).data.augment is False
+
+
 def test_fit_proof_steady_rate_math():
     """The chip-proof artifact's steady-state computation (scripts/
     fit_proof.py): each epoch's first logged interval is dropped (compile/

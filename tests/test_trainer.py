@@ -217,6 +217,18 @@ def test_trainer_zero1_wiring(tmp_path):
     assert trainer.fit() >= 0.0
 
 
+def test_trainer_threads_no_augment(imagefolder, tmp_path, devices8):
+    """DataConfig.augment=False (CLI --no-augment) reaches the train
+    loader: the fold-default is augment-on, the override serves clean
+    loads (the packed path then ships identity augment params)."""
+    cfg = _config(imagefolder, tmp_path)
+    assert Trainer(cfg).train_loader.augment is True
+    cfg = dataclasses.replace(cfg,
+                              data=dataclasses.replace(cfg.data,
+                                                       augment=False))
+    assert Trainer(cfg).train_loader.augment is False
+
+
 def test_trainer_rejects_fold_smaller_than_global_batch(imagefolder):
     """drop_last + a train fold smaller than one global batch would train
     ZERO steps per epoch while still checkpointing — refuse loudly."""

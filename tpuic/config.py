@@ -57,6 +57,12 @@ class DataConfig:
     # unseeded (dp/loader.py:23) — a correctness bug (ranks see inconsistent
     # shards). We seed identically on every host and fold in the epoch.
     shuffle_seed: int = 0
+    # Train-fold augmentation master switch. The reference hard-wires its
+    # rot90/flip/jitter chain on every train sample (dp/loader.py:63-83);
+    # that chain assumes orientation-free imagery. For orientation-sensitive
+    # datasets (digits: rot90/flip alias 6<->9, 2<->5) False trains on clean
+    # decodes while val/normalization behavior is unchanged.
+    augment: bool = True
     # Augmentation probabilities (reference dp/loader.py:63-83).
     p_vflip: float = 0.5
     p_hflip: float = 0.5

@@ -83,7 +83,8 @@ class Trainer:
         self.train_loader = Loader(self.train_ds, global_batch, step_mesh,
                                    seed=d.shuffle_seed, num_workers=d.num_workers,
                                    prefetch=d.prefetch, drop_last=True,
-                                   device_cache_bytes=cache_total)
+                                   device_cache_bytes=cache_total,
+                                   augment=None if d.augment else False)
         if self.train_loader.steps_per_epoch() == 0:
             # drop_last with a fold smaller than ONE global batch would
             # otherwise train zero steps per epoch while still writing

@@ -103,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused-loss", action="store_true",
                    help="use the Pallas fused weighted-CE kernel "
                         "(tpuic/kernels/cross_entropy.py)")
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable the train-fold rot90/flip/jitter chain "
+                        "(orientation-sensitive datasets, e.g. digits); "
+                        "normalization and val behavior are unchanged")
     p.add_argument("--no-pack", action="store_true",
                    help="disable the packed uint8 cache + device-side "
                         "augmentation; decode every epoch like the reference")
@@ -176,7 +180,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         val_batch_size=args.val_batchsize,
                         prefetch=args.prefetch,
                         device_cache_mb=args.device_cache_mb,
-                        pack=not args.no_pack, cache_dir=args.cache_dir),
+                        pack=not args.no_pack, cache_dir=args.cache_dir,
+                        augment=not args.no_augment),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
                           remat=args.remat, remat_policy=args.remat_policy,
