@@ -52,7 +52,11 @@ _RESNET_CFG = {
 
 def build_resnet(arch: str, num_classes: int = 7, mlp_head: bool = True):
     torch, tnn, F = _torch()
-    kind, sizes = _RESNET_CFG[arch]
+    # '-cifar' suffix: 3x3/s1 small stem, no maxpool — mirrors the flax
+    # zoo's small_stem variant (tpuic/models/resnet.py) so the digits/CIFAR
+    # convergence control trains the architecture tpuic actually ships.
+    small_stem = arch.endswith("-cifar")
+    kind, sizes = _RESNET_CFG[arch[:-len("-cifar")] if small_stem else arch]
     expansion = 1 if kind == "basic" else 4
 
     class BasicBlock(tnn.Module):
@@ -102,10 +106,12 @@ def build_resnet(arch: str, num_classes: int = 7, mlp_head: bool = True):
     class ResNet(tnn.Module):
         def __init__(self):
             super().__init__()
-            self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.conv1 = (tnn.Conv2d(3, 64, 3, 1, 1, bias=False) if small_stem
+                          else tnn.Conv2d(3, 64, 7, 2, 3, bias=False))
             self.bn1 = tnn.BatchNorm2d(64)
             self.relu = tnn.ReLU(inplace=True)
-            self.maxpool = tnn.MaxPool2d(3, 2, 1)
+            self.maxpool = (tnn.Identity() if small_stem
+                            else tnn.MaxPool2d(3, 2, 1))
             widths = (64, 128, 256, 512)
             inp = 64
             for s, (w, n) in enumerate(zip(widths, sizes), start=1):
@@ -527,7 +533,9 @@ def build_reference_model(arch: str, num_classes: int = 7,
     detected so --verify builds a replica that can actually load the
     checkpoint. ``image_size`` only matters for ViT (pos-embedding length);
     CNNs ignore it."""
-    if arch in _RESNET_CFG:
+    if (arch in _RESNET_CFG
+            or (arch.endswith("-cifar")
+                and arch[:-len("-cifar")] in _RESNET_CFG)):
         return build_resnet(arch, num_classes, mlp_head=mlp_head)
     if arch.startswith("inception"):
         return build_inception(num_classes, mlp_head=mlp_head)
