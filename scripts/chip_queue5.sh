@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-5 queue: the measurements this round owes the chip.
+#   1. Convergence on real data (VERDICT r4 item 2): digits ImageFolder
+#      through the full production path; overwrites the "tpuic" entry of
+#      perf/convergence_digits.json with a live-TPU training run (the
+#      torch control is CPU-side and kept).
+#   2. Resident-cache preemption resume (item 6): SIGTERM latch mid-epoch
+#      with the device-resident dataset active, resume, compare to an
+#      uninterrupted control.
+#   3. Warm-compile-cache bench timing (item 5a): two back-to-back
+#      bench.py runs; run 2's wall clock is the flap-window evidence.
+# Run via: nohup bash scripts/chip_poller5.sh &   (runs queue4 first)
+set -x -o pipefail
+failures=0
+cd /root/repo
+
+while pgrep -f "python bench.py|__graft_entry__" > /dev/null; do
+  echo "$(date -u +%FT%TZ) chip_queue5: waiting for bench/dryrun to finish"
+  sleep 60
+done
+
+python scripts/convergence_digits.py --skip-control 2>&1 | tail -6 \
+  || failures=$((failures+1))
+
+python scripts/resume_cache_proof.py 2>&1 | tail -6 \
+  || failures=$((failures+1))
+
+python scripts/bench_cache_timing.py 2>&1 | tail -2 \
+  || failures=$((failures+1))
+
+echo "chip_queue5: $failures item(s) failed"
+exit $failures
