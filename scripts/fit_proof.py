@@ -9,7 +9,8 @@ augmentation + the default log cadence) for a few epochs on the chip, and
 reports the in-loop steady-state images/sec next to bench.py's number.
 
 Writes perf/fit_proof.json. Done criterion: loop throughput within ~10% of
-bench.py's 2,674 img/s/chip at the same (resnet50, b128, bf16, sgd) config.
+the freshest live bench.py line (perf/bench_last_tpu.json) at the same
+(resnet50, b128, bf16, sgd) config.
 """
 
 from __future__ import annotations
@@ -110,7 +111,17 @@ def main():
     logs_per_epoch = steps_per_epoch // cfg.run.log_every_steps
     rate = steady_rate(rates, logs_per_epoch)
 
-    bench_rate = 2674.0  # perf/sweep.json b128
+    # Single source of truth for "bench img/s" (VERDICT r4 weak #2 /
+    # item 7): the freshest live bench.py line (bench_last_tpu.json,
+    # refreshed by the poller on every tunnel recovery), falling back to
+    # the r3 sweep only if this bench has never succeeded on chip.
+    bench_src = os.path.join(_REPO, "perf", "bench_last_tpu.json")
+    try:
+        with open(bench_src) as f:
+            bench_rate = float(json.load(f)["result"]["value"])
+        bench_src = "perf/bench_last_tpu.json"
+    except (OSError, ValueError, KeyError, TypeError):
+        bench_rate, bench_src = 2674.0, "perf/sweep.json b128 (fallback)"
     result = {
         "model": "resnet50", "batch": batch, "epochs": epochs,
         "n_train_images": n_per_class * 4,
@@ -120,6 +131,7 @@ def main():
         "best_val_acc": best,
         "loop_images_per_sec_median_steady": rate,
         "bench_images_per_sec": bench_rate,
+        "bench_source": bench_src,
         "loop_vs_bench": round(rate / bench_rate, 4),
         "all_logged_rates": rates,
         "platform": jax.devices()[0].platform,
