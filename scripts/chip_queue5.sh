@@ -28,5 +28,16 @@ python scripts/resume_cache_proof.py 2>&1 | tail -6 \
 python scripts/bench_cache_timing.py 2>&1 | tail -2 \
   || failures=$((failures+1))
 
+# 4. remat_policy='gelu' A/B (VERDICT r4 item 3's suggested experiment):
+#    MlpUpGelu under nn.remat drops the [B,N,4D] mlp_up pre-activation —
+#    the dual-output fusion writes the ViT-B b64 profile fingered as the
+#    largest single op class in the 0.537-vs-0.70 gap. --remat sweeps
+#    plain AND remat rows at each batch, so this one invocation is the
+#    A/B; b128 also probes whether the freed residuals move the
+#    allocator cliff (§10b).
+python scripts/perf_sweep.py --batches 64,128 --model vit-b16 \
+  --remat --remat-policy gelu \
+  --out perf/vit_gelu_remat.json 2>&1 | tail -4 || failures=$((failures+1))
+
 echo "chip_queue5: $failures item(s) failed"
 exit $failures

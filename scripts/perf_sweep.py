@@ -131,11 +131,12 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="also measure remat=True at each batch size")
     ap.add_argument("--remat-policy", default="dots",
-                    choices=["dots", "attention", "blocks"],
+                    choices=["dots", "attention", "blocks", "gelu"],
                     help="policy for the remat rows: 'attention' recomputes "
                          "only the [B,H,N,N] ViT tensors; 'blocks' = "
-                         "per-encoder-block, the long-context memory mode "
-                         "(see ModelConfig)")
+                         "per-encoder-block, the long-context memory mode; "
+                         "'gelu' drops only the ViT [B,N,4D] MLP "
+                         "pre-activations (lightest; see ModelConfig)")
     ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
     args = ap.parse_args()
 

@@ -238,6 +238,54 @@ def test_attention_remat_drops_quadratic_residuals_only():
     assert any(s == _VIT_MLP_HIDDEN for s in selective)
 
 
+def test_gelu_remat_policy_matches_plain_step():
+    """remat_policy='gelu' (save-anything-except the tagged ViT MLP
+    pre-activations) must be identical numerics to the un-remat step."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    g_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="gelu")
+    batch = {k: jnp.asarray(v) for k, v in synthetic_batch(4, 32, 3).items()}
+    plain = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+    gel = make_train_step(OCFG, g_cfg, mesh=None, donate=False)
+    _, m1 = plain(_vit_state(mcfg), batch)
+    _, m2 = gel(_vit_state(g_cfg), batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(m1["grad_norm"]), float(m2["grad_norm"]),
+                               rtol=1e-5)
+
+
+def test_gelu_remat_drops_only_mlp_preactivation():
+    """The 'gelu' contract (ViT remat_mlp -> MlpUpGelu under nn.remat,
+    driven through the production config path): per block, the plain
+    forward keeps SEVERAL [B,N,4D] residuals (pre-activation, its casts,
+    erf internals, gelu output); under the policy only the region OUTPUT
+    survives (one per block — mlp_down's backward operand), while the
+    [B,H,N,N] attention residuals are untouched — the policy must not
+    degenerate into broader remat."""
+    mcfg = ModelConfig(name="vit-tiny", num_classes=3, dtype="float32")
+    g_cfg = dataclasses.replace(mcfg, remat=True, remat_policy="gelu")
+    x = jnp.asarray(synthetic_batch(4, 32, 3)["image"])
+
+    plain = _residual_sizes(_vit_state(mcfg), x)
+    gelu = _residual_sizes(_vit_state(g_cfg), x)
+    depth = 2  # vit-tiny
+    n_plain = sum(1 for s in plain if s == _VIT_MLP_HIDDEN)
+    n_gelu = sum(1 for s in gelu if s == _VIT_MLP_HIDDEN)
+    assert n_plain >= 2 * depth, n_plain
+    assert n_gelu == depth, (n_plain, n_gelu)
+    # Attention residuals untouched by this policy.
+    assert any(s == _VIT_QUAD for s in gelu)
+
+
+def test_gelu_remat_noop_warns_for_non_vit():
+    from tpuic.train.step import resolve_remat_policy
+
+    cfg = ModelConfig(name="resnet18-cifar", num_classes=3,
+                      dtype="float32", remat=True, remat_policy="gelu")
+    with pytest.warns(UserWarning, match="no effect"):
+        assert resolve_remat_policy(cfg) is None
+
+
 def test_blocks_remat_policy_matches_plain_step():
     """remat_policy='blocks' (ViT remat_blocks: each encoder block under
     nn.remat) must be identical numerics to the un-remat step."""

@@ -124,6 +124,16 @@ class ModelConfig:
     #                 19.5 GB (flash) / 41.1 GB (dense) vs 15.75 HBM
     #                 (PERF_ANALYSIS.md §10f). Composes with any attention
     #                 impl; ViT-only (warns and no-ops elsewhere).
+    #   'gelu'      — ViT ``remat_mlp``: each block's Dense(mlp_up)+GELU
+    #                 runs under nn.remat (models/vit.py MlpUpGelu), so
+    #                 the [B,N,4D] pre-activation is never a residual —
+    #                 the mlp_up fusion writes ONE output instead of two
+    #                 and the backward recomputes W1·x per block. The
+    #                 lightest policy, aimed at the dual-output mlp_up
+    #                 writes the ViT-B b64 profile fingered (§10f).
+    #                 ViT-only (warns and no-ops elsewhere); in MoE ViTs
+    #                 the dense-MLP blocks still benefit (the routed
+    #                 SwitchMoEMlp blocks are untouched).
     remat_policy: str = "dots"
     # Inception aux-logits loss weight (reference train.py:52).
     aux_loss_weight: float = 0.4

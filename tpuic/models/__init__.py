@@ -43,7 +43,7 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     bn_eps: float = 1e-5, attention: str = "dense",
                     mesh=None, bn_f32_stats: bool = True,
                     drop_path: float = 0.0, remat_core: bool = False,
-                    remat_blocks: bool = False):
+                    remat_blocks: bool = False, remat_mlp: bool = False):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     if attention not in ATTENTION_IMPLS:
@@ -54,7 +54,8 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                    param_dtype=param_dtype, bn_momentum=bn_momentum,
                    bn_eps=bn_eps, attention=attention, mesh=mesh,
                    bn_f32_stats=bn_f32_stats, drop_path=drop_path,
-                   remat_core=remat_core, remat_blocks=remat_blocks), has_aux
+                   remat_core=remat_core, remat_blocks=remat_blocks,
+                   remat_mlp=remat_mlp), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
@@ -64,7 +65,8 @@ def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  bn_f32_stats: bool = True,
                  drop_path: float = 0.0,
                  remat_core: bool = False,
-                 remat_blocks: bool = False) -> Classifier:
+                 remat_blocks: bool = False,
+                 remat_mlp: bool = False) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
@@ -73,7 +75,8 @@ def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                                         bn_f32_stats=bn_f32_stats,
                                         drop_path=drop_path,
                                         remat_core=remat_core,
-                                        remat_blocks=remat_blocks)
+                                        remat_blocks=remat_blocks,
+                                        remat_mlp=remat_mlp)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
@@ -95,16 +98,21 @@ def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
                         # model (ViT remat_blocks, nn.remat per encoder
                         # block) — the long-context memory mode.
                         remat_blocks=(cfg.remat
-                                      and cfg.remat_policy == "blocks"))
+                                      and cfg.remat_policy == "blocks"),
+                        # 'gelu' likewise: MlpUpGelu under nn.remat (ViT
+                        # remat_mlp) — the mlp_up pre-activation is never
+                        # a residual; see models/vit.py MlpUpGelu.
+                        remat_mlp=(cfg.remat
+                                   and cfg.remat_policy == "gelu"))
 
 
 def _register_builtins():
     def _rn(factory, **extra):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks):
+                 remat_blocks, remat_mlp):
             del (num_classes, attention, mesh, drop_path, remat_core,
-                 remat_blocks)
+                 remat_blocks, remat_mlp)
             return factory(dtype=dtype, param_dtype=param_dtype,
                            bn_momentum=bn_momentum, bn_eps=bn_eps,
                            bn_f32_stats=bn_f32_stats, **extra)
@@ -124,11 +132,11 @@ def _register_builtins():
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks):
+                 remat_blocks, remat_mlp):
             # torch effnet: eps 1e-3; f32 stats kept (experiment is
             # ResNet-scoped, ModelConfig.bn_f32_stats).
             del (num_classes, bn_eps, attention, mesh, bn_f32_stats,
-                 drop_path, remat_core, remat_blocks)
+                 drop_path, remat_core, remat_blocks, remat_mlp)
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -140,11 +148,12 @@ def _register_builtins():
     def _vit_factory(ctor):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks):
+                 remat_blocks, remat_mlp):
             del num_classes, bn_momentum, bn_eps, bn_f32_stats  # no BN in ViT
             return ctor(dtype=dtype, param_dtype=param_dtype,
                         attention=attention, mesh=mesh, drop_path=drop_path,
-                        remat_core=remat_core, remat_blocks=remat_blocks)
+                        remat_core=remat_core, remat_blocks=remat_blocks,
+                        remat_mlp=remat_mlp)
         return make
 
     register("vit-b16", _vit_factory(_vit.vit_b16))
@@ -160,10 +169,10 @@ def _register_builtins():
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
              attention, mesh, bn_f32_stats, drop_path, remat_core,
-             remat_blocks):
+             remat_blocks, remat_mlp):
         # torch inception: eps 1e-3 (module default); f32 stats kept.
         del (bn_eps, attention, mesh, bn_f32_stats, drop_path,
-             remat_core, remat_blocks)
+             remat_core, remat_blocks, remat_mlp)
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

@@ -37,6 +37,44 @@ ATTENTION_IMPLS = ("dense", "flash", "ring", "ring-flash", "ulysses",
                    "ulysses-flash")
 
 
+class MlpUpGelu(nn.Module):
+    """Dense(mlp_up) + exact GELU as one rematerializable region
+    (ModelConfig.remat_policy='gelu').
+
+    Under ``nn.remat`` nothing inside the region is saved: the [B,N,4D]
+    pre-activation — together with its dtype-cast copies and the erf-vjp
+    internals, which a step-level names policy demonstrably still saves
+    (print_saved_residuals; see resolve_remat_policy's note) — never
+    becomes a residual. The backward recomputes W1·x + gelu from the
+    [B,N,D] region input; the only 4D-wide residual left is the region
+    OUTPUT, which mlp_down's backward needs regardless. Targets the
+    dual-output mlp_up fusion writes the ViT-B b64 profile fingered as
+    the largest single contributor to the 0.537-vs-0.70 MFU gap
+    (PERF_ANALYSIS.md §10f).
+
+    The math and the param layout replicate the ``nn.Dense`` this
+    replaces (kernel/bias under the same module name, same init, same
+    dtype promotion, exact erf GELU) so checkpoints, the torch
+    converter, and sharding rules are unaffected."""
+
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                         ("embed", "model")),
+            (x.shape[-1], self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), self.param_dtype)
+        x, kernel, bias = nn.dtypes.promote_dtype(x, kernel, bias,
+                                                  dtype=self.dtype)
+        return nn.gelu(x @ kernel + bias, approximate=False)
+
+
 class MultiHeadAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
@@ -144,6 +182,8 @@ class EncoderBlock(nn.Module):
     drop_path: float = 0.0
     # See MultiHeadAttention.remat_core.
     remat_core: bool = False
+    # See MlpUpGelu (ModelConfig.remat_policy='gelu').
+    remat_mlp: bool = False
 
     def _residual(self, x: jnp.ndarray, y: jnp.ndarray,
                   deterministic: bool) -> jnp.ndarray:
@@ -176,14 +216,9 @@ class EncoderBlock(nn.Module):
                              dtype=self.dtype, param_dtype=self.param_dtype,
                              name="moe")(y, deterministic)
         else:
-            y = _dense(d * self.mlp_ratio, "mlp_up", self.dtype,
-                       self.param_dtype, ("embed", "model"))(y)
-            # approximate=False: torchvision ViT uses EXACT (erf) GELU;
-            # flax's default tanh approximation differs by ~5e-4 per
-            # activation, which compounds across 12 blocks in converted-
-            # checkpoint parity. Elementwise either way — XLA fuses it
-            # into the adjacent matmul, no TPU cost.
-            y = nn.gelu(y, approximate=False)
+            up_cls = (nn.remat(MlpUpGelu) if self.remat_mlp else MlpUpGelu)
+            y = up_cls(d * self.mlp_ratio, self.dtype, self.param_dtype,
+                       name="mlp_up")(y)
             y = _dense(d, "mlp_down", self.dtype, self.param_dtype,
                        ("model", "embed"))(y)
         if self.dropout:
@@ -224,6 +259,8 @@ class ViT(nn.Module):
     # 'flash' the per-block recompute peak is O(N·D), which is what lets
     # flash train through shapes where dense cannot even rematerialize.
     remat_blocks: bool = False
+    # See MlpUpGelu (ModelConfig.remat_policy='gelu').
+    remat_mlp: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -253,6 +290,7 @@ class ViT(nn.Module):
                           self.dtype, self.param_dtype, self.attention,
                           self.mesh, moe, dp,
                           remat_core=self.remat_core,
+                          remat_mlp=self.remat_mlp,
                           name=f"block{i}")(x, not train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_final")(x)

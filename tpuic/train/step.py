@@ -97,6 +97,27 @@ def resolve_remat_policy(model_cfg: ModelConfig):
                 "remat_policy='dots' for whole-forward remat.",
                 stacklevel=2)
         return None
+    if model_cfg.remat_policy == "gelu":
+        # Model-level, like 'attention': ViT ``remat_mlp`` runs each
+        # block's Dense(mlp_up)+GELU under nn.remat (models/vit.py
+        # MlpUpGelu), so the [B,N,4D] pre-activation is never a residual —
+        # the mlp_up fusion writes ONE output instead of two (the
+        # dual-output writes PERF_ANALYSIS §10f fingered) and the backward
+        # recomputes W1·x per block. NOT expressible as a step-level names
+        # policy: save-anything-except a checkpoint_name'd pre-activation
+        # still saves its dtype-cast copies and the erf-vjp internals at
+        # the same [B,N,4D] size (verified with print_saved_residuals).
+        # In MoE ViTs the dense-MLP blocks still benefit; the routed
+        # SwitchMoEMlp blocks are untouched.
+        if "vit" not in model_cfg.name:
+            warnings.warn(
+                f"remat_policy='gelu' has no effect for model="
+                f"'{model_cfg.name}': only the ViT encoder has the "
+                "rematerializable mlp_up+GELU region; NO remat is "
+                "applied. Use remat_policy='dots' for whole-forward "
+                "remat.",
+                stacklevel=2)
+        return None
     if model_cfg.remat_policy == "blocks":
         # Per-encoder-block nn.remat lives in the model (ViT
         # ``remat_blocks``): residuals are the block inputs only, the
@@ -111,7 +132,7 @@ def resolve_remat_policy(model_cfg: ModelConfig):
                 stacklevel=2)
         return None
     raise ValueError(f"unknown remat_policy '{model_cfg.remat_policy}'; "
-                     f"available: ['dots', 'attention', 'blocks']")
+                     f"available: ['dots', 'attention', 'blocks', 'gelu']")
 
 
 def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,

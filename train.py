@@ -143,12 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize the forward in backward (trade FLOPs "
                         "for activation memory/bandwidth)")
     p.add_argument("--remat-policy", default="dots",
-                   choices=["dots", "attention", "blocks"],
+                   choices=["dots", "attention", "blocks", "gelu"],
                    help="what --remat saves: 'dots' recomputes all "
                         "activation-sized tensors; 'attention' recomputes "
                         "ONLY the [B,H,N,N] attention logits/probs (ViT); "
                         "'blocks' saves only encoder-block inputs (ViT "
-                        "long-context memory mode)")
+                        "long-context memory mode); 'gelu' drops only the "
+                        "ViT MLP pre-activations (lightest — one fewer "
+                        "[B,N,4D] HBM write/read per block)")
     p.add_argument("--drop-path", type=float, default=0.0,
                    help="stochastic-depth rate for ViT backbones (last "
                         "block; linear DeiT ramp from 0)")
