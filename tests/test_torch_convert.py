@@ -169,6 +169,30 @@ def test_export_resnet_roundtrips_into_torch_replica():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_export_resnet_cifar_roundtrips_into_torch_replica():
+    """The small-stem variant (the digits/CIFAR convergence recipe's
+    model) flows back to torch too: build_resnet('resnet18-cifar') —
+    3x3/s1 stem, no maxpool — loads the export strict=True and matches
+    logits at 32px."""
+    from tpuic.checkpoint.torch_convert import export_resnet
+
+    model = create_model("resnet18-cifar", 10, dtype="float32")
+    x = np.random.default_rng(5).normal(size=(2, 32, 32, 3)).astype(
+        np.float32)
+    v = model.init(jax.random.key(2), jnp.zeros((1, 32, 32, 3)), train=False)
+    want = np.asarray(model.apply(v, jnp.asarray(x), train=False))
+
+    sd = export_resnet(dict(v["params"]), dict(v["batch_stats"]), prefix="")
+    replica = build_resnet("resnet18-cifar", num_classes=10).eval()
+    replica.load_state_dict(
+        {k: torch.as_tensor(np.asarray(val)) for k, val in sd.items()},
+        strict=True)
+    with torch.no_grad():
+        got = replica(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+
 def test_export_cli_from_orbax_checkpoint(tmp_path, capsys):
     """--export-torch: Orbax checkpoint dir -> reference-layout torch file
     that --verify then validates against the replica."""
