@@ -13,9 +13,12 @@ while true; do
   if python -c "
 from tpuic.runtime.axon_guard import tpu_reachable
 import sys; sys.exit(0 if tpu_reachable(150) else 1)"; then
-    # 1-core host: never contend with pytest or an already-running queue.
-    while pgrep -f "pytest|chip_queue" > /dev/null; do
-      log "tunnel up; waiting for pytest/queue to finish"
+    # 1-core host, 1 chip: never contend with pytest, an already-running
+    # queue, or a driver-run bench/dryrun (two concurrent benches would
+    # skew both measurements).
+    while pgrep -f "pytest|chip_queue|python bench.py|__graft_entry__" \
+        > /dev/null; do
+      log "tunnel up; waiting for pytest/queue/bench/dryrun to finish"
       sleep 60
     done
     log "tunnel up; refreshing bench line"
