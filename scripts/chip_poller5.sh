@@ -8,19 +8,16 @@
 # Usage: nohup bash scripts/chip_poller5.sh > perf/chip_poller5.log 2>&1 &
 set -o pipefail
 cd /root/repo
+. scripts/chip_wait.sh
 log() { echo "$(date -u +%FT%TZ) $*"; }
 while true; do
   if python -c "
 from tpuic.runtime.axon_guard import tpu_reachable
 import sys; sys.exit(0 if tpu_reachable(150) else 1)"; then
     # 1-core host, 1 chip: never contend with pytest, an already-running
-    # queue, or a driver-run bench/dryrun (two concurrent benches would
-    # skew both measurements).
-    while pgrep -f "pytest|chip_queue|python bench.py|__graft_entry__" \
-        > /dev/null; do
-      log "tunnel up; waiting for pytest/queue/bench/dryrun to finish"
-      sleep 60
-    done
+    # queue, or any driver-run measurement (two concurrent benches would
+    # skew both). Pattern shared with the queue scripts (chip_wait.sh).
+    chip_wait "chip_queue|$MEASURE_PAT" "tunnel up"
     log "tunnel up; refreshing bench line"
     timeout 900 python bench.py 2>&1 | tail -1
     for q in scripts/chip_queue4.sh scripts/chip_queue5.sh; do

@@ -12,12 +12,10 @@ failures=0
 cd /root/repo
 
 # Don't contend with a driver-run bench/dryrun on the single chip (the
-# poller already waits for pytest; these measurements are the round's
+# pattern lives in chip_wait.sh; these measurements are the round's
 # record and must not be skewed by queue traffic).
-while pgrep -f "python bench.py|__graft_entry__" > /dev/null; do
-  echo "$(date -u +%FT%TZ) chip_queue4: waiting for bench/dryrun to finish"
-  sleep 60
-done
+. scripts/chip_wait.sh
+chip_wait "$MEASURE_PAT" "chip_queue4"
 
 python scripts/long_seq_bench.py --sizes 1024 --batch 16 --remat \
   --remat-policy blocks \

@@ -13,11 +13,9 @@
 set -x -o pipefail
 failures=0
 cd /root/repo
+. scripts/chip_wait.sh
 
-while pgrep -f "python bench.py|__graft_entry__" > /dev/null; do
-  echo "$(date -u +%FT%TZ) chip_queue5: waiting for bench/dryrun to finish"
-  sleep 60
-done
+chip_wait "$MEASURE_PAT" "chip_queue5"
 
 python scripts/convergence_digits.py --skip-control 2>&1 | tail -6 \
   || failures=$((failures+1))

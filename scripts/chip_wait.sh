@@ -1,0 +1,18 @@
+# Shared busy-wait for the 1-core / 1-chip host: block until no
+# measurement-skewing process is running. Source this and call chip_wait.
+#
+# MEASURE_PAT matches the SCRIPT NAMES (not the invocation prefix — a
+# 'python bench.py' prefix pattern misses '/usr/bin/python3
+# /root/repo/bench.py', exactly how bench_cache_timing.py spawns its
+# children): every perf/measurement entry point plus pytest. Queue
+# scripts wait on MEASURE_PAT; the poller adds 'chip_queue' on top (a
+# queue must NOT wait on its own name).
+MEASURE_PAT='bench\.py|perf_sweep\.py|long_seq_bench\.py|pallas_smoke\.py|packed_valid_smoke\.py|fit_proof\.py|resume_cache_proof\.py|convergence_digits\.py|bench_data\.py|__graft_entry__|pytest'
+
+chip_wait() {
+  # $1: pgrep -f pattern; $2: log tag
+  while pgrep -f "$1" > /dev/null; do
+    echo "$(date -u +%FT%TZ) $2: waiting for running measurement/tests"
+    sleep 60
+  done
+}

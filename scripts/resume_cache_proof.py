@@ -57,7 +57,7 @@ def main() -> None:
     on_cpu = jax.devices()[0].platform == "cpu"
     work = tempfile.mkdtemp(prefix="tpuic_resume_cache_")
 
-    def cfg(ckpt, log_dir):
+    def cfg(ckpt):
         return Config(
             data=DataConfig(data_dir=DATA_ROOT, resize_size=32,
                             batch_size=128, augment=False,
@@ -86,8 +86,8 @@ def main() -> None:
         return calls
 
     t0 = time.perf_counter()
-    control = Trainer(cfg(os.path.join(work, "ck_a"),
-                          os.path.join(work, "log_a")))
+    control = Trainer(cfg(os.path.join(work, "ck_a")),
+                      log_dir=os.path.join(work, "log_a"))
     assert control.train_loader.resident, \
         "resident cache did not engage — the proof target is the resident path"
     steps_per_epoch = control.train_loader.steps_per_epoch()
@@ -95,15 +95,15 @@ def main() -> None:
     control_s = time.perf_counter() - t0
 
     trip_offset = max(1, steps_per_epoch // 2)
-    interrupted = Trainer(cfg(os.path.join(work, "ck_b"),
-                              os.path.join(work, "log_b")))
+    interrupted = Trainer(cfg(os.path.join(work, "ck_b")),
+                          log_dir=os.path.join(work, "log_b"))
     assert interrupted.train_loader.resident
     trip_after(interrupted, steps_per_epoch + trip_offset)
     interrupted.fit()
 
     t1 = time.perf_counter()
-    resumed = Trainer(cfg(os.path.join(work, "ck_b"),
-                          os.path.join(work, "log_b")))
+    resumed = Trainer(cfg(os.path.join(work, "ck_b")),
+                      log_dir=os.path.join(work, "log_b"))
     assert resumed.train_loader.resident
     assert (resumed.start_epoch, resumed.start_step) == (1, trip_offset), (
         f"resume geometry: expected (1, {trip_offset}), got "
