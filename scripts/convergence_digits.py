@@ -52,7 +52,9 @@ def ensure_dataset() -> None:
         print(f"built digits ImageFolder: {counts}")
 
 
-def run_tpuic(epochs: int) -> dict:
+def run_tpuic(epochs: int, model: str = "resnet18-cifar",
+              optimizer: str = "sgd", lr: float = LR,
+              mixup: float = 0.0, cutmix: float = 0.0) -> dict:
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
@@ -71,11 +73,12 @@ def run_tpuic(epochs: int) -> dict:
     cfg = Config(
         data=DataConfig(data_dir=DATA_ROOT, resize_size=32, batch_size=BATCH,
                         augment=False),
-        model=ModelConfig(name="resnet18-cifar", num_classes=10,
+        model=ModelConfig(name=model, num_classes=10,
                           dtype="float32" if on_cpu else "bfloat16"),
-        optim=OptimConfig(optimizer="sgd", learning_rate=LR,
+        optim=OptimConfig(optimizer=optimizer, learning_rate=lr,
                           warmup_epochs=WARMUP_EPOCHS,
                           weight_decay=WEIGHT_DECAY,
+                          mixup_alpha=mixup, cutmix_alpha=cutmix,
                           class_weights=(), milestones=()),
         run=RunConfig(epochs=epochs, ckpt_dir=ckpt, save_period=20,
                       resume=False, log_every_steps=10),
@@ -87,10 +90,11 @@ def run_tpuic(epochs: int) -> dict:
     wall = time.perf_counter() - t0
     return {
         "framework": "tpuic",
-        "model": "resnet18-cifar", "resize": 32, "batch": BATCH,
-        "optimizer": f"sgd(momentum=0.9, wd={WEIGHT_DECAY})",
-        "schedule": f"warmup_cosine(lr={LR}, warmup={WARMUP_EPOCHS}ep)",
+        "model": model, "resize": 32, "batch": BATCH,
+        "optimizer": f"{optimizer}(wd={WEIGHT_DECAY})",
+        "schedule": f"warmup_cosine(lr={lr}, warmup={WARMUP_EPOCHS}ep)",
         "epochs": epochs, "augment": False,
+        "mixup": mixup, "cutmix": cutmix,
         "n_train": len(trainer.train_ds), "n_val": len(trainer.val_ds),
         "best_val_top1": best,
         "wall_s": round(wall, 1),
@@ -188,6 +192,17 @@ def run_torch_control(epochs: int) -> dict:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=EPOCHS)
+    p.add_argument("--model", default="resnet18-cifar",
+                   help="secondary models (e.g. vit-tiny) are recorded "
+                        "under 'tpuic_<model>'; the torch control pairs "
+                        "with the primary resnet18-cifar entry only")
+    p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--lr", type=float, default=LR)
+    p.add_argument("--mixup", type=float, default=0.0,
+                   help="orientation-SAFE augmentation for ViT-family "
+                        "runs (rot/flip alias digit classes; mixup/cutmix "
+                        "do not)")
+    p.add_argument("--cutmix", type=float, default=0.0)
     p.add_argument("--skip-tpuic", action="store_true")
     p.add_argument("--skip-control", action="store_true")
     args = p.parse_args()
@@ -208,8 +223,12 @@ def main() -> None:
         "n_images": 1797, "classes": 10, "native_size": "8x8",
     })
     if not args.skip_tpuic:
-        result["tpuic"] = run_tpuic(args.epochs)
-        print(json.dumps(result["tpuic"], indent=2))
+        key = ("tpuic" if args.model == "resnet18-cifar"
+               else f"tpuic_{args.model}")
+        result[key] = run_tpuic(args.epochs, model=args.model,
+                                optimizer=args.optimizer, lr=args.lr,
+                                mixup=args.mixup, cutmix=args.cutmix)
+        print(json.dumps(result[key], indent=2))
     if not args.skip_control:
         result["torch_control"] = run_torch_control(args.epochs)
         print(json.dumps(result["torch_control"], indent=2))
