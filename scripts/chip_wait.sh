@@ -11,8 +11,29 @@ MEASURE_PAT='bench\.py|perf_sweep\.py|long_seq_bench\.py|pallas_smoke\.py|packed
 
 chip_wait() {
   # $1: pgrep -f pattern; $2: log tag
-  while pgrep -f "$1" > /dev/null; do
-    echo "$(date -u +%FT%TZ) $2: waiting for running measurement/tests"
+  #
+  # pgrep -f matches the FULL argv, and the session driver (`claude -p
+  # --append-system-prompt ...`) embeds the literal strings "bench.py" and
+  # "pytest" in its prompt argv — so a raw `pgrep -f "$MEASURE_PAT"` matches
+  # the always-running driver and deadlocks the wait (this exact hang ate the
+  # 08:29Z recovery window). Filter matches down to real measurement
+  # processes: skip ourselves, and skip anything whose cmdline is the driver
+  # or its sh/bash wrappers (identified by the claude/append-system-prompt
+  # argv, which no measurement process has).
+  while true; do
+    local busy=""
+    local p cmd
+    for p in $(pgrep -f "$1" 2>/dev/null); do
+      [ "$p" = "$$" ] && continue
+      cmd=$(tr '\0' ' ' 2>/dev/null < "/proc/$p/cmdline") || continue
+      case "$cmd" in
+        *claude*|*append-system-prompt*) continue ;;
+      esac
+      busy="$p:${cmd:0:80}"
+      break
+    done
+    [ -z "$busy" ] && return 0
+    echo "$(date -u +%FT%TZ) $2: waiting for running measurement/tests ($busy)"
     sleep 60
   done
 }
