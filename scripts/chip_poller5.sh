@@ -20,7 +20,7 @@ import sys; sys.exit(0 if tpu_reachable(150) else 1)"; then
     chip_wait "chip_queue|$MEASURE_PAT" "tunnel up"
     log "tunnel up; refreshing bench line"
     timeout 900 python bench.py 2>&1 | tail -1
-    for q in scripts/chip_queue4.sh scripts/chip_queue5.sh; do
+    for q in scripts/chip_queue4.sh scripts/chip_queue5.sh scripts/chip_queue6.sh; do
       stamp="perf/.$(basename "$q" .sh)_done"
       if [ ! -e "$stamp" ]; then
         log "running $q"

@@ -1,0 +1,92 @@
+#!/bin/bash
+# Round-5 queue #6: work stranded by the 08:52Z mid-queue4 tunnel flap,
+# plus the ViT-L follow-ups the fresh 0.543 datapoint motivates.
+#
+# Ran in the 08:32-08:52Z window (committed artifacts): bench x2
+# (2,559 / 2,537 img/s), true blocks-remat N=4097 (flash trains at
+# 1,843 ms; dense OOM 33 GB), ViT-L/16 MFU sweep (b16/32/64 =
+# 0.508/0.495/0.543), pallas_smoke with the PACKED kernels' first
+# Mosaic execution (fwd 4.2e-7 / bwd 3.4e-4 vs dense-HIGHEST, green).
+#
+# NOTE: a poller started before this file existed parsed its queue list
+# at startup and will NEVER run queue6 — restart the poller (kill + re-
+# nohup chip_poller5.sh) after its current queue pass stamps out.
+#
+# Stranded there (items 1-4 below), plus all of chip_queue5 (the poller
+# stamped it after its items failed fast on the unreachable guard), plus
+# new ViT-L probes (items 9-10): 0.543 at b64 says width alone doesn't
+# move the plateau; gelu-remat frees the [B,N,4D] mlp_up residuals, so
+# b96/b128 can test whether more per-matmul work does.
+set -x -o pipefail
+failures=0
+cd /root/repo
+. scripts/chip_wait.sh
+chip_wait "$MEASURE_PAT" "chip_queue6"
+
+# -- stranded from chip_queue4 ------------------------------------------
+# Skip any row the resumed queue4 already produced ON CHIP (the hung-at-
+# init sweep completes if the tunnel comes back while it still lives);
+# existing CPU-platform artifacts do NOT count as done.
+have_tpu() {  # $1: perf json path -> exit 0 iff it records a CLEAN tpu run
+  # An artifact with any "error" key does not count: long_seq_bench and
+  # perf_sweep write their --out file even when individual rows failed
+  # (e.g. a timeout after the first row), and skipping on that would
+  # strand exactly the measurement this requeue exists to capture.
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+text = json.dumps(d)
+ok = ('"tpu"' in text or 'TPU v5' in text) and '"error"' not in text
+sys.exit(0 if ok else 1)
+EOF
+}
+
+have_tpu perf/packed_valid_smoke.json \
+  || python scripts/packed_valid_smoke.py 2>&1 | tail -2 \
+  || failures=$((failures+1))
+have_tpu perf/vit_flash_folded.json \
+  || TPUIC_FLASH_PACKED=0 python scripts/perf_sweep.py --batches 64 \
+    --model vit-b16 --attention flash \
+    --out perf/vit_flash_folded.json 2>&1 | tail -3 \
+  || failures=$((failures+1))
+have_tpu perf/vit_flash_packed.json \
+  || python scripts/perf_sweep.py --batches 64 --model vit-b16 \
+    --attention flash \
+    --out perf/vit_flash_packed.json 2>&1 | tail -3 \
+  || failures=$((failures+1))
+have_tpu perf/long_seq_2305_packed.json \
+  || python scripts/long_seq_bench.py --sizes 768 --batch 16 --remat \
+    --remat-policy blocks \
+    --out perf/long_seq_2305_packed.json 2>&1 | tail -4 \
+  || failures=$((failures+1))
+
+# -- stranded chip_queue5 (all items failed fast on the 08:52Z flap) ----
+# Same skip rule: the old poller still lists queue5 and re-runs it on
+# recovery before this script; whatever it lands on chip stays landed.
+have_tpu perf/convergence_digits.json \
+  || python scripts/convergence_digits.py --skip-control 2>&1 | tail -6 \
+  || failures=$((failures+1))
+have_tpu perf/resume_cache_proof.json \
+  || python scripts/resume_cache_proof.py 2>&1 | tail -6 \
+  || failures=$((failures+1))
+have_tpu perf/bench_cache_timing.json \
+  || python scripts/bench_cache_timing.py 2>&1 | tail -2 \
+  || failures=$((failures+1))
+have_tpu perf/vit_gelu_remat.json \
+  || python scripts/perf_sweep.py --batches 64,128 --model vit-b16 \
+    --remat --remat-policy gelu \
+    --out perf/vit_gelu_remat.json 2>&1 | tail -4 \
+  || failures=$((failures+1))
+
+# -- new: ViT-L frontier probes motivated by the 0.543 plateau ----------
+# gelu-remat drops the twelve [B,N,4D] mlp_up pre-activations (1.2 GB at
+# b64), opening batch headroom past the 12.7-of-15.75 GB dense b64 peak.
+python scripts/perf_sweep.py --batches 64,96 --model vit-l16 \
+  --remat --remat-policy gelu \
+  --out perf/vitl_gelu_remat.json 2>&1 | tail -4 || failures=$((failures+1))
+
+echo "chip_queue6: $failures item(s) failed"
+exit $failures
