@@ -1,0 +1,24 @@
+#!/bin/bash
+# One-shot watchdog: the poller running since before chip_queue6.sh was
+# written parsed its queue list at startup and will never run queue6.
+# Wait until that poller's current pass is fully stamped out (queue5 done,
+# no queue script active), then replace it with a fresh chip_poller5.sh
+# that picks up the full queue4/5/6 list.
+# Usage: nohup bash scripts/poller_swap.sh >> perf/chip_poller5.log 2>&1 &
+set -o pipefail
+cd /root/repo
+log() { echo "$(date -u +%FT%TZ) poller_swap: $*"; }
+while true; do
+  if [ -e perf/.chip_queue5_done ] && ! pgrep -f 'scripts/chip_queue[0-9]' > /dev/null; then
+    old=$(pgrep -f 'bash scripts/chip_poller5.sh' | head -1)
+    if [ -n "$old" ] && [ "$old" != "$$" ]; then
+      log "queues stamped; replacing poller pid $old"
+      kill "$old"
+      sleep 2
+    fi
+    nohup bash scripts/chip_poller5.sh >> perf/chip_poller5.log 2>&1 &
+    log "new poller started pid $!"
+    exit 0
+  fi
+  sleep 120
+done
