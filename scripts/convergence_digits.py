@@ -206,6 +206,13 @@ def main() -> None:
     p.add_argument("--skip-tpuic", action="store_true")
     p.add_argument("--skip-control", action="store_true")
     args = p.parse_args()
+
+    # The torch control path is jax-free; only the tpuic run needs the
+    # backend, so only it refuses on a dead tunnel.
+    if not args.skip_tpuic:
+        from tpuic.runtime.axon_guard import exit_if_unreachable
+        exit_if_unreachable()
+
     ensure_dataset()
 
     result = {}

@@ -45,10 +45,8 @@ def steady_rate(rates, logs_per_epoch):
 
 
 def main():
-    from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
-    if is_tunneled() and not tpu_reachable(150):
-        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
-        sys.exit(2)
+    from tpuic.runtime.axon_guard import exit_if_unreachable
+    exit_if_unreachable()
 
     import jax
 

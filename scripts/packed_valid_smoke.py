@@ -24,10 +24,8 @@ sys.path.insert(0, _REPO)
 
 
 def main():
-    from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
-    if is_tunneled() and not tpu_reachable(150):
-        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
-        return 2
+    from tpuic.runtime.axon_guard import exit_if_unreachable
+    exit_if_unreachable()
 
     import jax
     import jax.numpy as jnp

@@ -45,6 +45,9 @@ def jax_block(x):
 
 
 def main():
+    from tpuic.runtime.axon_guard import exit_if_unreachable
+    exit_if_unreachable()
+
     import jax
     import jax.numpy as jnp
     import numpy as np

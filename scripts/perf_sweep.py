@@ -140,6 +140,9 @@ def main():
     ap.add_argument("--out", default=os.path.join(_REPO, "perf", "sweep.json"))
     args = ap.parse_args()
 
+    from tpuic.runtime.axon_guard import exit_if_unreachable
+    exit_if_unreachable()
+
     import jax
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(_REPO, "tests", ".jax_cache"))

@@ -34,10 +34,8 @@ OUT = os.path.join(_REPO, "perf", "resume_cache_proof.json")
 
 
 def main() -> None:
-    from tpuic.runtime.axon_guard import is_tunneled, tpu_reachable
-    if is_tunneled() and not tpu_reachable(150):
-        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
-        sys.exit(2)
+    from tpuic.runtime.axon_guard import exit_if_unreachable
+    exit_if_unreachable()
 
     import jax
 

@@ -1,0 +1,45 @@
+"""exit_if_unreachable: the shared fail-fast for measurement entry points.
+
+On the tunneled dev image a dead tunnel makes backend init hang ~25 min
+before raising; every chip-measurement script refuses instead via this
+one helper (it ate a recovery window when three scripts lacked it —
+2026-08-01). The reference has no analogue (its NCCL init also hangs on
+a dead rendezvous, train.py:102); this is dev-environment armor.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuic.runtime import axon_guard
+
+
+def test_noop_when_not_tunneled(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    # Must not probe at all: a probe would cost 150 s on real CPU hosts.
+    monkeypatch.setattr(axon_guard, "tpu_reachable",
+                        lambda *a, **k: pytest.fail("probed when untunneled"))
+    axon_guard.exit_if_unreachable()
+
+
+def test_exits_with_json_line_when_unreachable(monkeypatch, capsys):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(axon_guard, "tpu_reachable", lambda *a, **k: False)
+    with pytest.raises(SystemExit) as e:
+        axon_guard.exit_if_unreachable()
+    assert e.value.code == 2
+    # The line the chip queues grep for / have_tpu guards reject on.
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out) == {
+        "error": "tpu tunnel unreachable; not starting"}
+
+
+def test_noop_when_reachable(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    seen = {}
+    monkeypatch.setattr(axon_guard, "tpu_reachable",
+                        lambda t: seen.setdefault("timeout", t) or True)
+    axon_guard.exit_if_unreachable(timeout=7.0)
+    assert seen["timeout"] == 7.0

@@ -63,6 +63,28 @@ def tpu_reachable(timeout: float = 120.0) -> bool:
         return False
 
 
+def exit_if_unreachable(timeout: float | None = None,
+                        exit_code: int = 2) -> None:
+    """Refuse to start when the tunneled backend is down.
+
+    Measurement entry points (perf_sweep, long_seq_bench, fit_proof,
+    convergence_digits, *_smoke, *_proof) call this first: on the dev
+    image a dead tunnel makes backend init HANG ~25 minutes before
+    raising (measured 2026-08-01 08:56Z), which burns exactly the
+    recovery windows the chip queues exist to exploit. Prints the shared
+    machine-readable error line and exits. No-op off the tunneled image
+    (real TPU hosts, or deliberate CPU runs with the axon vars stripped).
+    """
+    import json
+    if timeout is None:
+        # Same operator knob ensure_reachable_or_cpu honors, default 150
+        # (the queue scripts' established probe budget).
+        timeout = float(os.environ.get("TPUIC_TPU_PROBE_S", "150"))
+    if is_tunneled() and not tpu_reachable(timeout):
+        print(json.dumps({"error": "tpu tunnel unreachable; not starting"}))
+        raise SystemExit(exit_code)
+
+
 def ensure_reachable_or_cpu(timeout: float | None = None,
                             verbose: bool = True,
                             always_probe: bool = False) -> bool:
