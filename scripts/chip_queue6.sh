@@ -81,6 +81,12 @@ have_tpu perf/vit_gelu_remat.json \
     --out perf/vit_gelu_remat.json 2>&1 | tail -4 \
   || failures=$((failures+1))
 
+# Refresh the loop-vs-bench ratio against a same-session bench line (the
+# tracked-number rule: every ratio cites the freshest live bench). No
+# have_tpu guard — the committed artifact IS a TPU run (r4); the point
+# is recomputing it against today's line.
+python scripts/fit_proof.py 2>&1 | tail -4 || failures=$((failures+1))
+
 # -- new: ViT-L frontier probes motivated by the 0.543 plateau ----------
 # gelu-remat drops the twelve [B,N,4D] mlp_up pre-activations (1.2 GB at
 # b64), opening batch headroom past the 12.7-of-15.75 GB dense b64 peak.
