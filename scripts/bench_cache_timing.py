@@ -25,8 +25,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run_once(tag: str) -> dict:
     t0 = time.perf_counter()
+    # NO_WAIT: this script's artifact IS the children's wall clock; the
+    # pre-bench contention wait (bench.py:_wait_for_measurements) would
+    # silently inflate it by up to 180 s per run.
+    env = dict(os.environ, TPUIC_BENCH_NO_WAIT="1")
     proc = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
-                          capture_output=True, text=True, timeout=900)
+                          capture_output=True, text=True, timeout=900,
+                          env=env)
     wall = time.perf_counter() - t0
     line = {}
     for ln in reversed((proc.stdout or "").strip().splitlines()):

@@ -23,6 +23,12 @@ cd /root/repo
 . scripts/chip_wait.sh
 chip_wait "$MEASURE_PAT" "chip_queue6"
 
+# Between items, yield to any driver-initiated bench.py (bench itself
+# waits only 180 s bounded; the queue can afford the full wait). The
+# pattern is anchored on a separator so it cannot substring-match
+# long_seq_bench.py (a queue item!) or bench_data.py.
+yield_to_bench() { chip_wait '[ /]bench\.py' "chip_queue6-yield"; }
+
 # -- stranded from chip_queue4 ------------------------------------------
 # Skip any row the resumed queue4 already produced ON CHIP (the hung-at-
 # init sweep completes if the tunnel comes back while it still lives);
@@ -44,19 +50,23 @@ sys.exit(0 if ok else 1)
 EOF
 }
 
+yield_to_bench
 have_tpu perf/packed_valid_smoke.json \
   || python scripts/packed_valid_smoke.py 2>&1 | tail -2 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/vit_flash_folded.json \
   || TPUIC_FLASH_PACKED=0 python scripts/perf_sweep.py --batches 64 \
     --model vit-b16 --attention flash \
     --out perf/vit_flash_folded.json 2>&1 | tail -3 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/vit_flash_packed.json \
   || python scripts/perf_sweep.py --batches 64 --model vit-b16 \
     --attention flash \
     --out perf/vit_flash_packed.json 2>&1 | tail -3 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/long_seq_2305_packed.json \
   || python scripts/long_seq_bench.py --sizes 768 --batch 16 --remat \
     --remat-policy blocks \
@@ -66,15 +76,19 @@ have_tpu perf/long_seq_2305_packed.json \
 # -- stranded chip_queue5 (all items failed fast on the 08:52Z flap) ----
 # Same skip rule: the old poller still lists queue5 and re-runs it on
 # recovery before this script; whatever it lands on chip stays landed.
+yield_to_bench
 have_tpu perf/convergence_digits.json \
   || python scripts/convergence_digits.py --skip-control 2>&1 | tail -6 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/resume_cache_proof.json \
   || python scripts/resume_cache_proof.py 2>&1 | tail -6 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/bench_cache_timing.json \
   || python scripts/bench_cache_timing.py 2>&1 | tail -2 \
   || failures=$((failures+1))
+yield_to_bench
 have_tpu perf/vit_gelu_remat.json \
   || python scripts/perf_sweep.py --batches 64,128 --model vit-b16 \
     --remat --remat-policy gelu \
@@ -85,11 +99,13 @@ have_tpu perf/vit_gelu_remat.json \
 # tracked-number rule: every ratio cites the freshest live bench). No
 # have_tpu guard — the committed artifact IS a TPU run (r4); the point
 # is recomputing it against today's line.
+yield_to_bench
 python scripts/fit_proof.py 2>&1 | tail -4 || failures=$((failures+1))
 
 # -- new: ViT-L frontier probes motivated by the 0.543 plateau ----------
 # gelu-remat drops the twelve [B,N,4D] mlp_up pre-activations (1.2 GB at
 # b64), opening batch headroom past the 12.7-of-15.75 GB dense b64 peak.
+yield_to_bench
 python scripts/perf_sweep.py --batches 64,96 --model vit-l16 \
   --remat --remat-policy gelu \
   --out perf/vitl_gelu_remat.json 2>&1 | tail -4 || failures=$((failures+1))
