@@ -307,12 +307,16 @@ def _wait_for_measurements(max_wait: float = 180.0) -> dict:
     pat = _measure_pattern()
     skip = _ancestor_pids()
 
-    def contenders() -> list:
+    def contenders() -> tuple:
+        """(check_ok, procs): an explicit flag instead of a sentinel
+        string in the proc list — a legitimate contender whose argv
+        happens to contain a marker word must neither end the wait early
+        nor be persisted as a fake process (ADVICE r5)."""
         try:
             out = subprocess.run(["pgrep", "-fa", pat], capture_output=True,
                                  text=True, timeout=10).stdout
         except Exception:
-            return ["<contention check failed: pgrep unavailable>"]
+            return False, []
         procs = []
         for line in out.splitlines():
             parts = line.split(None, 1)
@@ -327,18 +331,20 @@ def _wait_for_measurements(max_wait: float = 180.0) -> dict:
             if "claude" in cmd or "append-system-prompt" in cmd:
                 continue
             procs.append(cmd[:60])
-        return procs
+        return True, procs
 
     t0 = time.time()
-    busy = contenders()
-    while busy and "failed" not in busy[0] and time.time() - t0 < max_wait:
+    ok, busy = contenders()
+    while ok and busy and time.time() - t0 < max_wait:
         time.sleep(15)
-        busy = contenders()
+        ok, busy = contenders()
     waited = round(time.time() - t0, 1)
     info = {}
     if waited >= 15:
         info["contention_wait_s"] = waited
-    if busy:
+    if not ok:
+        info["contention_check"] = "failed: pgrep unavailable"
+    elif busy:
         info["contended_with"] = busy[:3]
     return info
 
@@ -365,13 +371,28 @@ def main() -> None:
             # failed first (the result isn't mutated yet).
             if result.get("detail", {}).get("platform") == "tpu":
                 _record_tpu_success(result)
-            if errors:  # a preferred platform failed first
+            if (errors
+                    and result.get("detail", {}).get("platform") != "tpu"):
+                # A preferred platform failed AND this run is not itself
+                # chip evidence (a live-TPU success after, say, a failed
+                # CPU smoke must stay the headline). Dead-tunnel day: the
+                # headline becomes the freshest recorded live-TPU line
+                # (with explicit staleness) and this CPU run is demoted
+                # to a labeled smoke detail.
+                promoted = _promote_last_tpu(errors, cpu_result=result)
+                if promoted is not None:
+                    print(json.dumps(promoted), flush=True)
+                    return
                 result.setdefault("detail", {})["fallback"] = platform
                 result["error"] = "; ".join(errors)
                 _attach_last_tpu(result)
             print(json.dumps(result), flush=True)
             return
         errors.append(err)
+    promoted = _promote_last_tpu(errors)
+    if promoted is not None:
+        print(json.dumps(promoted), flush=True)
+        return
     out = {
         "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
         "error": "; ".join(errors) or "no platforms attempted",
@@ -395,6 +416,54 @@ def _record_tpu_success(result: dict) -> None:
                        "result": result}, f, indent=2)
     except OSError:
         pass
+
+
+def _promote_last_tpu(errors, cpu_result: dict = None):
+    """TPU unreachable this run: build the output line FROM the freshest
+    recorded live-TPU measurement (perf/bench_last_tpu.json), with
+    ``measured_at`` + ``staleness_s`` at top level beside value, and the
+    CPU fallback (when one ran) demoted to a clearly-labeled smoke
+    detail.  A BENCH headline must never read 0.74 img/s on a
+    dead-tunnel day when the chip's demonstrated number is on disk
+    (VERDICT r5 item 2).  Returns None when no live-TPU line exists —
+    callers then keep the old fallback shape (CPU value headlined,
+    ``last_tpu_measurement`` attached beside it)."""
+    try:
+        with open(_LAST_TPU_PATH) as f:
+            last = json.load(f)
+        r = dict(last["result"])
+        float(r["value"])  # malformed artifact -> old behavior
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    measured_at = last.get("measured")
+    staleness = None
+    if measured_at:
+        try:
+            import calendar
+            staleness = max(0, int(time.time() - calendar.timegm(
+                time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ"))))
+        except (ValueError, TypeError):  # corrupt/non-string 'measured'
+            pass
+    detail = dict(r.get("detail") or {})
+    detail["source"] = ("perf/bench_last_tpu.json — this bench's last "
+                        "live-TPU line, promoted to headline: TPU "
+                        "unreachable this run")
+    if cpu_result is not None:
+        cd = cpu_result.get("detail") or {}
+        detail["cpu_smoke"] = {
+            "note": "CPU fallback ran this round — smoke signal only, "
+                    "NOT comparable to the chip headline",
+            "value": cpu_result.get("value"),
+            "unit": cpu_result.get("unit"),
+            "platform": cd.get("platform"),
+            "global_batch": cd.get("global_batch"),
+            "step_time_ms": cd.get("step_time_ms"),
+        }
+    r["detail"] = detail
+    r["measured_at"] = measured_at
+    r["staleness_s"] = staleness
+    r["error"] = "; ".join(e for e in errors if e)
+    return r
 
 
 def _attach_last_tpu(result: dict) -> None:

@@ -14,9 +14,9 @@
 #
 # Stranded there (items 1-4 below), plus all of chip_queue5 (the poller
 # stamped it after its items failed fast on the unreachable guard), plus
-# new ViT-L probes (items 9-10): 0.543 at b64 says width alone doesn't
-# move the plateau; gelu-remat frees the [B,N,4D] mlp_up residuals, so
-# b96/b128 can test whether more per-matmul work does.
+# new ViT-L probes: 0.543 at b64 says width alone doesn't move the
+# plateau; gelu-remat frees the [B,N,4D] mlp_up residuals, so the
+# b96/b128 rows can test whether more per-matmul work does.
 set -x -o pipefail
 failures=0
 cd /root/repo
@@ -105,8 +105,11 @@ python scripts/fit_proof.py 2>&1 | tail -4 || failures=$((failures+1))
 # -- new: ViT-L frontier probes motivated by the 0.543 plateau ----------
 # gelu-remat drops the twelve [B,N,4D] mlp_up pre-activations (1.2 GB at
 # b64), opening batch headroom past the 12.7-of-15.75 GB dense b64 peak.
+# b96 AND b128 (PERF_ANALYSIS §13d cites both probes): b128 is the AI~170
+# point the §13d target band assumes — if it OOMs even under gelu-remat,
+# that row's absence is itself the datapoint.
 yield_to_bench
-python scripts/perf_sweep.py --batches 64,96 --model vit-l16 \
+python scripts/perf_sweep.py --batches 64,96,128 --model vit-l16 \
   --remat --remat-policy gelu \
   --out perf/vitl_gelu_remat.json 2>&1 | tail -4 || failures=$((failures+1))
 

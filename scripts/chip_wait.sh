@@ -9,31 +9,38 @@
 # queue must NOT wait on its own name).
 MEASURE_PAT='bench\.py|perf_sweep\.py|long_seq_bench\.py|pallas_smoke\.py|packed_valid_smoke\.py|fit_proof\.py|resume_cache_proof\.py|convergence_digits\.py|bench_data\.py|__graft_entry__|pytest'
 
+# Non-blocking probe: is any real measurement process matching $1 alive?
+# Returns 0 and sets CHIP_BUSY_PROC="pid:argv" when one is; returns 1 when
+# clear. The driver filter lives HERE and only here:
+#
+# pgrep -f matches the FULL argv, and the session driver (`claude -p
+# --append-system-prompt ...`) embeds the literal strings "bench.py" and
+# "pytest" in its prompt argv — so a raw `pgrep -f "$MEASURE_PAT"` matches
+# the always-running driver and deadlocks the wait (this exact hang ate the
+# 08:29Z recovery window). Filter matches down to real measurement
+# processes: skip ourselves, and skip anything whose cmdline is the driver
+# or its sh/bash wrappers (identified by the claude/append-system-prompt
+# argv, which no measurement process has).
+chip_busy() {
+  local p cmd
+  CHIP_BUSY_PROC=""
+  for p in $(pgrep -f "$1" 2>/dev/null); do
+    [ "$p" = "$$" ] && continue
+    cmd=$(tr '\0' ' ' 2>/dev/null < "/proc/$p/cmdline") || continue
+    case "$cmd" in
+      *claude*|*append-system-prompt*) continue ;;
+    esac
+    CHIP_BUSY_PROC="$p:${cmd:0:80}"
+    return 0
+  done
+  return 1
+}
+
 chip_wait() {
-  # $1: pgrep -f pattern; $2: log tag
-  #
-  # pgrep -f matches the FULL argv, and the session driver (`claude -p
-  # --append-system-prompt ...`) embeds the literal strings "bench.py" and
-  # "pytest" in its prompt argv — so a raw `pgrep -f "$MEASURE_PAT"` matches
-  # the always-running driver and deadlocks the wait (this exact hang ate the
-  # 08:29Z recovery window). Filter matches down to real measurement
-  # processes: skip ourselves, and skip anything whose cmdline is the driver
-  # or its sh/bash wrappers (identified by the claude/append-system-prompt
-  # argv, which no measurement process has).
-  while true; do
-    local busy=""
-    local p cmd
-    for p in $(pgrep -f "$1" 2>/dev/null); do
-      [ "$p" = "$$" ] && continue
-      cmd=$(tr '\0' ' ' 2>/dev/null < "/proc/$p/cmdline") || continue
-      case "$cmd" in
-        *claude*|*append-system-prompt*) continue ;;
-      esac
-      busy="$p:${cmd:0:80}"
-      break
-    done
-    [ -z "$busy" ] && return 0
-    echo "$(date -u +%FT%TZ) $2: waiting for running measurement/tests ($busy)"
+  # $1: pgrep -f pattern; $2: log tag. Blocks until chip_busy clears.
+  while chip_busy "$1"; do
+    echo "$(date -u +%FT%TZ) $2: waiting for running measurement/tests ($CHIP_BUSY_PROC)"
     sleep 60
   done
+  return 0
 }

@@ -2,14 +2,31 @@
 # One-shot watchdog: the poller running since before chip_queue6.sh was
 # written parsed its queue list at startup and will never run queue6.
 # Wait until that poller's current pass is fully stamped out (queue5 done,
-# no queue script active), then replace it with a fresh chip_poller5.sh
-# that picks up the full queue4/5/6 list.
+# no queue script active, NO live measurement), then replace it with a
+# fresh chip_poller5.sh that picks up the full queue4/5/6 list.
 # Usage: nohup bash scripts/poller_swap.sh >> perf/chip_poller5.log 2>&1 &
 set -o pipefail
 cd /root/repo
+. scripts/chip_wait.sh
 log() { echo "$(date -u +%FT%TZ) poller_swap: $*"; }
+
+# Non-blocking MEASURE_PAT probe (ADVICE r5): the old gate only checked
+# queue scripts, so a poller mid-bench (e.g. a driver-initiated bench.py
+# between queue items) could be swapped out UNDER a running measurement.
+# chip_busy is chip_wait.sh's single-source predicate (same pattern, same
+# self/driver exclusions).
+measure_busy() {
+  if chip_busy "$MEASURE_PAT"; then
+    log "measurement live ($CHIP_BUSY_PROC) — holding the swap"
+    return 0
+  fi
+  return 1
+}
+
 while true; do
-  if [ -e perf/.chip_queue5_done ] && ! pgrep -f 'scripts/chip_queue[0-9]' > /dev/null; then
+  if [ -e perf/.chip_queue5_done ] \
+      && ! pgrep -f 'scripts/chip_queue[0-9]' > /dev/null \
+      && ! measure_busy; then
     old=$(pgrep -f 'bash scripts/chip_poller5.sh' | head -1)
     if [ -n "$old" ] && [ "$old" != "$$" ]; then
       log "queues stamped; replacing poller pid $old"

@@ -59,6 +59,7 @@ def test_ema_eval_uses_ema_weights():
         base, rel=1e-3)
 
 
+@pytest.mark.slow  # EMA fit + ckpt roundtrip + predict: ~40 s CPU
 def test_ema_checkpoint_roundtrip_and_predict(tmp_path):
     """fit() with EMA on: checkpoint carries ema_params; resume restores
     them; predict --model auto scores with the EMA weights (accuracy equals

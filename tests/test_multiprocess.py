@@ -28,6 +28,12 @@ import sys
 import numpy as np
 import pytest
 
+# Tier-2: each test spawns REAL distributed child processes running full
+# train/checkpoint flows — the suite's slowest tests by far (30-55 s
+# apiece on a small host), and they additionally need a jax build whose
+# CPU backend implements multiprocess collectives. `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = r'''

@@ -8,6 +8,7 @@ replicated path exactly (same global batch, same reductions).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuic.config import MeshConfig, ModelConfig, OptimConfig
@@ -68,6 +69,7 @@ class TestPartitionSpecs:
 
 
 class TestShardedStepNumerics:
+    @pytest.mark.slow  # 8-way FSDP step numerics: ~30 s on 2 cores
     def test_fsdp_matches_replicated(self, devices8):
         mesh = make_mesh(MeshConfig(data=8), devices8)
         mcfg, ocfg, state = _make("resnet18", mesh)
@@ -127,6 +129,7 @@ class TestZero1:
         assert any("data" in sp for sp in opt_specs), \
             f"no sharded moments: {opt_specs}"
 
+    @pytest.mark.slow  # 8-way ZeRO-1 step numerics: ~20 s on 2 cores
     def test_zero1_matches_replicated(self, devices8):
         """One ZeRO-1 step == one replicated step, and the updated moments
         keep their sharding while params stay replicated."""

@@ -25,6 +25,7 @@ def _config(imagefolder, tmp_path, epochs=2):
     )
 
 
+@pytest.mark.slow  # full 2-epoch fit + resume: ~30 s CPU training
 def test_fit_end_to_end_and_resume(imagefolder, tmp_path, devices8):
     cfg = _config(imagefolder, tmp_path, epochs=2)
     trainer = Trainer(cfg, log_dir=str(tmp_path / "logs"))
@@ -46,6 +47,7 @@ def test_fit_end_to_end_and_resume(imagefolder, tmp_path, devices8):
     assert trainer2.fit() == pytest.approx(best)
 
 
+@pytest.mark.slow  # full fit watching log cadence: ~30 s CPU training
 def test_deferred_logging_emits_every_interval(imagefolder, tmp_path,
                                                devices8):
     """The deferred-readback log path (round-4 tunnel-stall fix) must not
@@ -131,6 +133,7 @@ def test_collect_misclassified_ids(imagefolder, tmp_path, devices8):
         len(trainer.last_misclassified)
 
 
+@pytest.mark.slow  # trains to compare weighted losses: ~15 s CPU
 def test_auto_class_weights(tmp_path):
     """--class-weights auto derives inverse-frequency weights from the
     train fold; rarer classes get proportionally larger weights."""
@@ -188,6 +191,7 @@ def test_auto_class_weights_pads_to_model_head(tmp_path):
     assert w[0] == w[1] == 1.0  # balanced present classes -> ~1 each
 
 
+@pytest.mark.slow  # one sharded epoch end to end: ~30 s CPU training
 def test_trainer_zero1_wiring(tmp_path):
     """MeshConfig.zero1 engages state sharding: params replicated, at least
     one optimizer moment sharded over 'data'; one epoch runs."""

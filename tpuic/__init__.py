@@ -24,6 +24,9 @@ structural analysis):
 - ``tpuic.metrics``     — AverageMeter / accuracy / host-0 logging (replaces reference
                           utils.py)
 - ``tpuic.ops``         — Pallas TPU kernels for fused hot ops
+- ``tpuic.serve``       — dynamic-batching AOT inference engine (request
+                          queue + micro-batcher, padding buckets, compiled-
+                          executable cache; ``python -m tpuic.serve``)
 """
 
 __version__ = "0.1.0"
@@ -37,6 +40,7 @@ _LAZY = {
     "create_model": ("tpuic.models", "create_model"),
     "available_models": ("tpuic.models", "available_models"),
     "run_predict": ("tpuic.predict", "run_predict"),
+    "InferenceEngine": ("tpuic.serve", "InferenceEngine"),
 }
 
 

@@ -1,3 +1,4 @@
+from tpuic.checkpoint.loading import load_inference_variables  # noqa: F401
 from tpuic.checkpoint.manager import CheckpointManager, lenient_restore  # noqa: F401
 from tpuic.checkpoint.torch_convert import (  # noqa: F401
     convert_reference_checkpoint, convert_resnet, load_reference_checkpoint)

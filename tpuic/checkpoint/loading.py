@@ -1,0 +1,80 @@
+"""Checkpoint -> inference variables (shared by tpuic.predict and
+tpuic.serve).
+
+Restoring weights for *inference* has stricter rules than the trainer's
+lenient resume, and they used to live inline in predict.py; the serving
+engine needs the identical behavior, so they live here once:
+
+- a typo'd ``--ckpt-dir``/track is a hard error, never a confident run
+  on fresh-init noise;
+- a partial key-intersection merge (a training-time feature for
+  architecture evolution) is a hard error too — fresh-init leaves in
+  the forward mean the wrong ``--model``/``--num-classes``;
+- EMA-trained checkpoints serve their EMA weights
+  (``state.inference_params`` — the weights 'best' was selected on);
+- the returned tree is device-resident (one up-front transfer; host
+  leaves would be re-uploaded on every jitted/compiled call).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def load_inference_variables(cfg, *, track: str = "best", log=print):
+    """Build ``cfg.model`` and restore its inference variables.
+
+    ``cfg.run.init_from`` (a torch checkpoint) wins over the
+    CheckpointManager track.  Returns ``(model, variables)`` with
+    ``variables = {'params': ..., 'batch_stats': ...}`` on device.
+    """
+    import jax
+
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.models import create_model_from_config
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+
+    mcfg = cfg.model
+    model = create_model_from_config(mcfg)
+    state = create_train_state(
+        model, make_optimizer(cfg.optim), jax.random.key(0),
+        (1, cfg.data.resize_size, cfg.data.resize_size, 3),
+        ema=cfg.optim.ema_decay > 0)
+
+    if cfg.run.init_from:
+        from tpuic.checkpoint.torch_convert import init_state_from_torch
+        state = init_state_from_torch(state, cfg.run.init_from, mcfg.name,
+                                      log=log)
+    else:
+        mgr = CheckpointManager(cfg.run.ckpt_dir, mcfg.name)
+        if not os.path.isdir(os.path.join(mgr.root, track)):
+            # restore_into would silently return the fresh init — a typo'd
+            # ckpt dir must not produce confident predictions of noise.
+            raise FileNotFoundError(
+                f"no '{track}' checkpoint under {mgr.root}")
+        state, next_epoch, best = mgr.restore_into(state, track=track)
+        loaded = mgr.last_restore_loaded  # None = exact sharded restore
+        if loaded is not None and loaded[0] < loaded[1]:
+            raise ValueError(
+                f"checkpoint {mgr.root}/{track} restored only "
+                f"{loaded[0]}/{loaded[1]} leaves into model '{mcfg.name}' — "
+                "wrong --model or --num-classes for this checkpoint?")
+        # last_restore_meta carries the SAVED (epoch, step_in_epoch)
+        # regardless of which restore branch ran (next_epoch is
+        # saved_epoch+1 for end-of-epoch checkpoints but the same epoch
+        # for mid-epoch preemption flushes — not invertible here).
+        meta = getattr(mgr, "last_restore_meta", None)
+        if meta is not None:
+            saved_epoch, sie = meta
+            saved_at = (f"epoch {saved_epoch} step {sie}" if sie >= 0
+                        else f"epoch {saved_epoch}")
+        else:
+            saved_at = f"epoch {max(0, next_epoch - 1)}"
+        log(f"[load] restored {mcfg.name}/{track} (saved at "
+            f"{saved_at}, best {best:.2f})")
+
+    variables = jax.device_put(
+        {"params": state.inference_params,
+         "batch_stats": state.batch_stats})
+    return model, variables

@@ -1,3 +1,3 @@
-from tpuic.metrics.meters import (AverageMeter, accuracy,  # noqa: F401
-                                  topk_accuracy)
+from tpuic.metrics.meters import (AverageMeter, LatencyMeter,  # noqa: F401
+                                  accuracy, topk_accuracy)
 from tpuic.metrics.logging import host0_print, MetricLogger  # noqa: F401

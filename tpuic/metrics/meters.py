@@ -38,6 +38,49 @@ class AverageMeter:
         self.avg = self.sum / max(self.count, 1)
 
 
+class LatencyMeter:
+    """Latency percentile tracker over a bounded sliding window.
+
+    ``update`` records one sample (seconds); ``percentiles`` reads
+    p50/p95/p99 (milliseconds) over the last ``window`` samples, so a
+    long-running server reports recent behavior rather than its whole
+    lifetime.  count/total cover every sample ever recorded (for
+    throughput math).  Not thread-safe by itself — callers that update
+    from several threads hold their own lock (tpuic.serve.metrics does).
+    """
+
+    def __init__(self, window: int = 8192) -> None:
+        from collections import deque
+        self._win = deque(maxlen=max(1, int(window)))
+        self.count = 0
+        self.total = 0.0
+
+    def reset(self) -> None:
+        self._win.clear()
+        self.count = 0
+        self.total = 0.0
+
+    def update(self, seconds: float) -> None:
+        s = float(seconds)
+        self._win.append(s)
+        self.count += 1
+        self.total += s
+
+    def percentiles_ms(self, qs=(50, 95, 99)) -> dict:
+        """{'p50': ms, ...} over the window; {} when no samples yet."""
+        if not self._win:
+            return {}
+        import numpy as np
+        arr = np.asarray(self._win, np.float64)
+        vals = np.percentile(arr, qs)
+        return {f"p{q}": round(1000.0 * float(v), 3)
+                for q, v in zip(qs, vals)}
+
+    @property
+    def mean_ms(self) -> float:
+        return 1000.0 * self.total / self.count if self.count else 0.0
+
+
 def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Per-sample 0/1 correctness; reference utils.py:25-27.
 

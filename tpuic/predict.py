@@ -35,17 +35,15 @@ import numpy as np
 
 
 def build_predict_fn(model):
-    """Jitted ``(variables, images) -> (probs, top_idx)`` forward."""
+    """Jitted ``(variables, images) -> (probs, top_idx)`` forward.
+
+    Kept for direct/one-shot callers; the fold-scoring loop below runs
+    the same forward through tpuic.serve's bucketed AOT executables
+    instead (fixed shapes, no per-batch-size recompiles)."""
     import jax
-    import jax.numpy as jnp
 
-    def fwd(variables, images):
-        logits = model.apply(variables, images, train=False)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        order = jnp.argsort(-probs, axis=-1)
-        return probs, order
-
-    return jax.jit(fwd)
+    from tpuic.serve import make_forward
+    return jax.jit(make_forward(model))
 
 
 def resolve_model_auto(ckpt_dir: str) -> dict:
@@ -81,14 +79,10 @@ def resolve_model_auto(ckpt_dir: str) -> dict:
 def run_predict(cfg, *, fold: str, track: str, top_k: int,
                 out_path: Optional[str], limit: int = 0) -> dict:
     """Programmatic entry; returns summary stats (rows written, accuracy)."""
-    import jax
-
-    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.checkpoint.loading import load_inference_variables
     from tpuic.data.folder import ImageFolderDataset
     from tpuic.data.pipeline import Loader
-    from tpuic.models import create_model_from_config
-    from tpuic.train.optimizer import make_optimizer
-    from tpuic.train.state import create_train_state
+    from tpuic.serve import InferenceEngine, default_buckets
 
     d = cfg.data
     # class_to_idx=None derives the canonical mapping from the train fold
@@ -111,57 +105,12 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
     if num_classes != mcfg.num_classes:
         import dataclasses
         mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
-    model = create_model_from_config(mcfg)
-    state = create_train_state(
-        model, make_optimizer(cfg.optim), jax.random.key(0),
-        (1, d.resize_size, d.resize_size, 3),
-        ema=cfg.optim.ema_decay > 0)
-
-    if cfg.run.init_from:
-        from tpuic.checkpoint.torch_convert import init_state_from_torch
-        state = init_state_from_torch(state, cfg.run.init_from, mcfg.name,
-                                      log=print)
-    else:
-        mgr = CheckpointManager(cfg.run.ckpt_dir, mcfg.name)
-        if not os.path.isdir(os.path.join(mgr.root, track)):
-            # restore_into would silently return the fresh init — a typo'd
-            # --ckpt-dir must not produce a confident CSV of noise.
-            raise FileNotFoundError(
-                f"no '{track}' checkpoint under {mgr.root}")
-        state, next_epoch, best = mgr.restore_into(state, track=track)
-        loaded = mgr.last_restore_loaded  # None = exact sharded restore
-        if loaded is not None and loaded[0] < loaded[1]:
-            # Inference needs the FULL tree: a partial key-intersection
-            # merge (a training-time feature for architecture evolution)
-            # means fresh-init leaves in the forward — erroring beats a
-            # confident CSV of noise. Mismatches here are almost always a
-            # wrong --model/--num-classes for the checkpoint.
-            raise ValueError(
-                f"checkpoint {mgr.root}/{track} restored only "
-                f"{loaded[0]}/{loaded[1]} leaves into model '{mcfg.name}' — "
-                "wrong --model or --num-classes for this checkpoint?")
-        # last_restore_meta carries the SAVED (epoch, step_in_epoch)
-        # regardless of which restore branch ran (next_epoch is
-        # saved_epoch+1 for end-of-epoch checkpoints but the same epoch
-        # for mid-epoch preemption flushes — not invertible here).
-        meta = getattr(mgr, "last_restore_meta", None)
-        if meta is not None:
-            saved_epoch, sie = meta
-            saved_at = (f"epoch {saved_epoch} step {sie}" if sie >= 0
-                        else f"epoch {saved_epoch}")
-        else:
-            saved_at = f"epoch {max(0, next_epoch - 1)}"
-        print(f"[predict] restored {mcfg.name}/{track} (saved at "
-              f"{saved_at}, best {best:.2f})")
-
-    # One up-front transfer: the lenient-restore path leaves host numpy
-    # leaves, which a jitted call would re-upload every batch. EMA-trained
-    # checkpoints predict with the EMA weights (state.inference_params,
-    # the same choice val_epoch makes).
-    variables = jax.device_put(
-        {"params": state.inference_params,
-         "batch_stats": state.batch_stats})
-    predict = build_predict_fn(model)
+    # Checkpoint -> device-resident inference variables, with the strict
+    # full-tree rules (missing track / partial merge = hard error) shared
+    # with tpuic.serve (tpuic/checkpoint/loading.py).
+    model, variables = load_inference_variables(
+        cfg.replace(model=mcfg), track=track,
+        log=lambda *a: print("[predict]", *a))
     # Class names come from the fold tree; an unlabeled flat fold has none,
     # so predictions fall back to the raw class index as a string.
     idx_to_class = {i: c for c, i in ds.class_to_idx.items()}
@@ -171,20 +120,31 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
 
     # augment=False: --fold train must be classified on CLEAN images; the
     # fold-derived default would rot90/flip/jitter them (ADVICE r3).
-    loader = Loader(ds, cfg.data.resolved_val_batch_size(), shuffle=False,
+    batch_size = cfg.data.resolved_val_batch_size()
+    loader = Loader(ds, batch_size, shuffle=False,
                     num_workers=d.num_workers, prefetch=d.prefetch,
                     augment=False)
+    # Fold scoring runs through the serving engine: full batches hit the
+    # one bucket == batch_size executable, and the tail batch submits only
+    # its valid rows, padded to the next-smaller bucket — fixed shapes
+    # everywhere, so no tail/batch-size-dependent recompiles and no
+    # full-width forward wasted on epoch padding. max_wait_ms=0: offline
+    # requests are already batch-sized, coalescing delay buys nothing.
+    engine = InferenceEngine(model, variables, image_size=d.resize_size,
+                             input_dtype=np.float32,
+                             buckets=default_buckets(batch_size),
+                             max_wait_ms=0.0, queue_size=8)
     rows, correct, count = [], 0, 0
-    for batch in loader.epoch(0):
-        probs, order = predict(variables, batch["image"])
-        probs, order = np.asarray(probs), np.asarray(order)
-        labels = np.asarray(batch["label"])
-        mask = np.asarray(batch["mask"])
-        for i, image_id in enumerate(batch.image_ids):
-            if mask[i] == 0:  # epoch padding
-                continue
+    done = False
+
+    def consume(fut, ids, labels_v):
+        nonlocal correct, count, done
+        probs, order = fut.result()
+        for i, image_id in enumerate(ids):
+            if done:
+                return
             row = {"image_id": image_id,
-                   "label": idx_to_class.get(int(labels[i]), "")
+                   "label": idx_to_class.get(int(labels_v[i]), "")
                             if has_labels else "",
                    "pred": idx_to_class.get(int(order[i, 0]), ""),
                    "prob": f"{probs[i, order[i, 0]]:.6f}"}
@@ -193,12 +153,41 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
                 row[f"prob_{j + 1}"] = f"{probs[i, order[i, j]]:.6f}"
             rows.append(row)
             if has_labels:
-                correct += int(order[i, 0] == labels[i])
+                correct += int(order[i, 0] == labels_v[i])
                 count += 1
             if limit and len(rows) >= limit:
+                done = True
+
+    import collections
+    pending = collections.deque()
+    try:
+        for batch in loader.epoch(0):
+            if done:
                 break
-        if limit and len(rows) >= limit:
-            break
+            mask = np.asarray(batch["mask"]) > 0  # epoch padding rows
+            if not mask.any():
+                continue
+            # Full batches pass through as-is — a packed-loader device
+            # array stays ON DEVICE end to end (the engine's exact-fit
+            # path ships it without a host bounce). Only the tail batch
+            # materializes on host to drop its padding rows.
+            imgs = batch["image"]
+            labels_v = np.asarray(batch["label"])
+            ids = batch.image_ids
+            if not mask.all():  # tail batch: submit only the valid rows
+                imgs = np.asarray(imgs)[mask]
+                labels_v = labels_v[mask]
+                ids = [iid for iid, m in zip(ids, mask) if m]
+            # Keep ~2 requests in flight: batch N+1's host assembly and
+            # H2D overlap batch N's device call (the engine pipelines
+            # internally; the window caps host memory).
+            pending.append((engine.submit(imgs), ids, labels_v))
+            while len(pending) >= 3:
+                consume(*pending.popleft())
+        while pending:
+            consume(*pending.popleft())
+    finally:
+        engine.close()
 
     if out_path:
         with open(out_path, "w", newline="") as f:
@@ -207,7 +196,8 @@ def run_predict(cfg, *, fold: str, track: str, top_k: int,
             w.writeheader()
             w.writerows(rows)
         print(f"[predict] wrote {len(rows)} rows -> {out_path}")
-    summary = {"rows": len(rows), "fold": fold}
+    summary = {"rows": len(rows), "fold": fold,
+               "engine": engine.stats.snapshot()}
     if has_labels and count:
         summary["accuracy"] = 100.0 * correct / count
         print(f"[predict] accuracy over {count} labeled samples: "

@@ -42,14 +42,18 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     # inserts collectives (jax>=0.9 defaults make_mesh to Explicit
     # sharding-in-types, which instead demands out_sharding annotations on
     # every contraction touching a sharded dim — not the model we want).
-    auto = (jax.sharding.AxisType.Auto,) * len(shape)
-    try:
-        return jax.make_mesh(shape, tuple(cfg.axis_names),
-                             axis_types=auto, devices=devices)
-    except TypeError:
-        # Older signature without axis_types/devices kwargs.
-        arr = np.asarray(devices).reshape(shape)
-        return Mesh(arr, tuple(cfg.axis_names))
+    # jax < 0.6 has no AxisType (no sharding-in-types): the plain Mesh
+    # fallback IS Auto semantics there.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is not None:
+        try:
+            return jax.make_mesh(shape, tuple(cfg.axis_names),
+                                 axis_types=(axis_type.Auto,) * len(shape),
+                                 devices=devices)
+        except TypeError:
+            pass  # older make_mesh signature without axis_types/devices
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(cfg.axis_names))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,22 @@
+"""tpuic.serve — dynamic-batching AOT inference engine.
+
+Online serving counterpart of the training loop's saturate-the-chip
+design: a bounded request queue + micro-batcher coalesces caller
+requests into a handful of pre-compiled fixed-shape device calls
+(padding buckets), with zero steady-state recompiles and per-request
+latency/throughput accounting.
+
+    from tpuic.serve import InferenceEngine
+    eng = InferenceEngine(model, variables, image_size=224,
+                          buckets=(1, 8, 32, 128))
+    eng.warmup()                      # AOT: one compile per bucket
+    fut = eng.submit(images_u8)       # [n,S,S,3] -> Future
+    probs, order = fut.result()
+
+``python -m tpuic.serve`` runs the stdin-JSONL / directory-watch driver
+(tpuic/serve/__main__.py) — no network dependency.
+"""
+
+from tpuic.serve.engine import (DEFAULT_BUCKETS, InferenceEngine,  # noqa: F401
+                                default_buckets, make_forward)
+from tpuic.serve.metrics import ServeStats  # noqa: F401

@@ -1,0 +1,269 @@
+"""``python -m tpuic.serve`` — online inference driver, no network needed.
+
+Two request sources, both feeding the same InferenceEngine:
+
+- **stdin JSONL** (default): one request per line,
+  ``{"id": "r1", "path": "img.png"}`` (``id`` optional, defaults to the
+  path).  Responses stream to --out (default stdout) as JSONL:
+  ``{"id", "pred", "prob", "topk": [[name, prob], ...]}``.
+- **directory watch** (``--watch DIR``): polls DIR for new image files
+  and classifies each once; ``--once`` processes the current contents
+  and exits (the tier-1-testable mode).
+
+Decode (PIL) of request N+1 overlaps the device call for batch N: the
+driver only *submits* work and drains completed futures opportunistically
+— the engine's batcher thread owns the device.
+
+    python -m tpuic.serve --ckpt-dir dtmodel/cp --model auto < reqs.jsonl
+    python -m tpuic.serve --ckpt-dir dtmodel/cp --watch incoming/ --once
+
+A final stats line (queue wait, pad efficiency, bucket histogram,
+latency percentiles, compile counts) goes to stderr on shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+
+import numpy as np
+
+
+def _load_image(path: str, size: int) -> np.ndarray:
+    """Decode + resize EXACTLY like the training/predict pipeline
+    (folder.py -> transforms.resize_nearest): the checkpoint's val
+    accuracy was measured on nearest-resized pixels, and serving the
+    same image through a different interpolation would silently shift
+    predictions relative to `python -m tpuic.predict`."""
+    from PIL import Image
+
+    from tpuic.data.transforms import resize_nearest
+    img = np.asarray(Image.open(path).convert("RGB"), np.uint8)
+    return resize_nearest(img, size)
+
+
+def _class_names(ckpt_dir: str, model: str, num_classes: int,
+                 classes_file: str) -> dict:
+    """index -> display name: --classes file (one name per line) wins,
+    else the class_to_idx.json sidecar the Trainer writes, else indices."""
+    names = {i: str(i) for i in range(num_classes)}
+    if classes_file:
+        with open(classes_file) as f:
+            for i, line in enumerate(ln.strip() for ln in f):
+                if line:
+                    names[i] = line
+        return names
+    sidecar = os.path.join(ckpt_dir, model, "class_to_idx.json")
+    try:
+        with open(sidecar) as f:
+            names.update({int(v): k for k, v in json.load(f).items()})
+    except (OSError, ValueError):
+        pass
+    return names
+
+
+def build_engine(args):
+    """Checkpoint -> warmed InferenceEngine (shared predict loading rules)."""
+    if args.compile_cache_dir:
+        # Persistent XLA compilation cache: warmup's per-bucket AOT
+        # compiles land on disk, so a server RESTART warms up from cache
+        # instead of recompiling (same mechanism the test suite and
+        # bench.py use).
+        import jax
+        cache = os.path.expanduser(args.compile_cache_dir)
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from tpuic.checkpoint.loading import load_inference_variables
+    from tpuic.config import (Config, DataConfig, ModelConfig, OptimConfig,
+                              RunConfig)
+    from tpuic.predict import resolve_model_auto
+    from tpuic.serve import InferenceEngine
+
+    model_name, num_classes, resize = args.model, args.num_classes, args.resize
+    ema_decay = 0.0
+    if model_name == "auto":
+        saved = resolve_model_auto(args.ckpt_dir)
+        model_name = saved["name"]
+        num_classes = num_classes or saved["num_classes"]
+        ema_decay = saved["ema_decay"]
+        if resize is None:
+            resize = saved["resize_size"]
+        print(f"[serve] auto-resolved model '{model_name}' "
+              f"(num_classes={num_classes}, resize={resize})",
+              file=sys.stderr)
+    elif not args.init_from:
+        # Explicit --model: still honor THIS model's config.json sidecar
+        # for ema_decay (same rule as tpuic.predict) — an EMA-trained
+        # checkpoint must serve its EMA weights (the ones 'best' was
+        # selected on), not silently fall back to the raw params.
+        sidecar = os.path.join(args.ckpt_dir, model_name, "config.json")
+        try:
+            with open(sidecar) as f:
+                ema_decay = float(
+                    json.load(f).get("optim", {}).get("ema_decay", 0.0))
+        except (OSError, ValueError, TypeError):
+            # Absent or corrupt sidecar (non-atomic trainer write) falls
+            # back to raw params, same as _class_names' fallback.
+            pass
+    if resize is None:
+        resize = 299
+    if num_classes <= 0:
+        raise SystemExit("serve: --num-classes required (or --model auto "
+                         "with a config.json sidecar)")
+    cfg = Config(
+        data=DataConfig(data_dir=".", resize_size=resize),
+        model=ModelConfig(name=model_name, num_classes=num_classes),
+        optim=OptimConfig(ema_decay=ema_decay),
+        run=RunConfig(ckpt_dir=args.ckpt_dir, init_from=args.init_from),
+    )
+    model, variables = load_inference_variables(
+        cfg, track=args.track, log=lambda *a: print("[serve]", *a,
+                                                    file=sys.stderr))
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    # Raw uint8 in, normalize fused into the compiled forward (4x less
+    # H2D than shipping float32 — the device_prep lesson).
+    engine = InferenceEngine(
+        model, variables, image_size=resize, input_dtype=np.uint8,
+        normalize=True, mean=cfg.data.mean, std=cfg.data.std,
+        buckets=buckets, max_wait_ms=args.max_wait_ms,
+        queue_size=args.queue_size)
+    t = engine.warmup()
+    print(f"[serve] warmup compiled {len(t)} bucket executables: {t}",
+          file=sys.stderr)
+    return engine, resize, num_classes, model_name
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Dynamic-batching inference server (stdin JSONL or "
+                    "directory watch)")
+    p.add_argument("--ckpt-dir", default="dtmodel/cp")
+    p.add_argument("--model", default="auto")
+    p.add_argument("--num-classes", type=int, default=0)
+    p.add_argument("--resize", type=int, default=None)
+    p.add_argument("--track", default="best", choices=("best", "latest"))
+    p.add_argument("--init-from", default="",
+                   help="torch checkpoint instead of a tpuic one")
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="padding-bucket ladder (comma list)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-size", type=int, default=256)
+    p.add_argument("--compile-cache-dir", default="~/.cache/tpuic/xla",
+                   help="persistent XLA compile cache (restarts warm up "
+                        "from disk); empty string disables")
+    p.add_argument("--top-k", type=int, default=1)
+    p.add_argument("--classes", default="",
+                   help="optional file of class names, one per line")
+    p.add_argument("--watch", default="",
+                   help="watch this directory for images instead of stdin")
+    p.add_argument("--poll-s", type=float, default=0.5)
+    p.add_argument("--once", action="store_true",
+                   help="with --watch: process current files, then exit")
+    p.add_argument("--out", default="", help="output JSONL (default stdout)")
+    args = p.parse_args(argv)
+
+    if args.classes and not os.path.isfile(args.classes):
+        # Validate BEFORE the checkpoint load + per-bucket AOT warmup —
+        # a typo'd path must not cost minutes of startup first.
+        raise SystemExit(f"serve: --classes file not found: {args.classes}")
+    engine, size, num_classes, model_name = build_engine(args)
+    names = _class_names(args.ckpt_dir, model_name, num_classes,
+                         args.classes)
+    k = max(1, min(args.top_k, num_classes))
+    out = open(args.out, "w") if args.out else sys.stdout
+    pending = deque()  # (id, Future) in submission order
+    served = 0
+
+    def drain(block: bool) -> None:
+        nonlocal served
+        while pending and (block or pending[0][1].done()):
+            rid, fut = pending.popleft()
+            try:
+                probs, order = fut.result()
+            except Exception as e:  # noqa: BLE001 — per-request error line
+                out.write(json.dumps({"id": rid, "error": str(e)}) + "\n")
+                out.flush()
+                continue
+            topk = [[names.get(int(order[0, j]), str(int(order[0, j]))),
+                     round(float(probs[0, order[0, j]]), 6)]
+                    for j in range(k)]
+            out.write(json.dumps({"id": rid, "pred": topk[0][0],
+                                  "prob": topk[0][1], "topk": topk}) + "\n")
+            out.flush()
+            served += 1
+
+    def submit(rid: str, path: str) -> bool:
+        """Decode + enqueue; False = decode failed (error line emitted)."""
+        try:
+            img = _load_image(path, size)
+        except Exception as e:  # noqa: BLE001
+            out.write(json.dumps({"id": rid, "error": f"decode: {e}"}) + "\n")
+            out.flush()
+            return False
+        pending.append((rid, engine.submit(img)))
+        drain(block=False)  # opportunistic: decode overlaps device work
+        return True
+
+    try:
+        if args.watch:
+            exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+            seen: set = set()
+            attempts: dict = {}
+            while True:
+                fresh = sorted(
+                    f for f in os.listdir(args.watch)
+                    if f.lower().endswith(exts) and f not in seen)
+                for f in fresh:
+                    if submit(f, os.path.join(args.watch, f)):
+                        seen.add(f)
+                        attempts.pop(f, None)
+                    else:
+                        # A file mid-copy decodes as truncated; retry on
+                        # later ticks, give up (and stop re-erroring)
+                        # after 3 — in --once mode immediately, there is
+                        # no later tick.
+                        attempts[f] = attempts.get(f, 0) + 1
+                        if args.once or attempts[f] >= 3:
+                            seen.add(f)
+                drain(block=False)
+                if args.once and not fresh and not pending:
+                    break
+                if args.once:
+                    drain(block=True)
+                    break
+                time.sleep(args.poll_s)
+        else:
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    path = req["path"]
+                except (ValueError, KeyError, TypeError):
+                    out.write(json.dumps(
+                        {"error": f"bad request line: {line[:80]}"}) + "\n")
+                    out.flush()
+                    continue
+                submit(str(req.get("id", path)), path)
+        drain(block=True)
+    except KeyboardInterrupt:
+        drain(block=True)
+    finally:
+        engine.close()
+        print(f"[serve] served {served} requests; stats: "
+              f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Serving counters: the numbers that tell you whether batching is working.
+
+Everything the engine's micro-batcher decides leaves a trace here:
+
+- **queue wait** (enqueue -> dispatch) and **total latency** (enqueue ->
+  result on host), p50/p95/p99 over a sliding window
+  (tpuic.metrics.LatencyMeter — the same primitive the training side's
+  meters build on).
+- **pad efficiency**: valid rows / device rows.  A stream of size-1
+  requests against a 128 bucket reads 0.008 here — the signal to shrink
+  the ladder or raise max_wait_ms.
+- **batch-size histogram**: device calls per bucket.
+- **compiles vs executable-cache hits**: the steady-state-recompiles=0
+  contract is asserted against ``compiles`` (tests/test_serve.py) — after
+  warmup every device call must be a cache hit.
+
+All updates happen under one lock: the engine touches this from its
+batcher thread while callers snapshot from theirs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from tpuic.metrics import LatencyMeter
+
+
+class ServeStats:
+    """Thread-safe counters for one InferenceEngine."""
+
+    def __init__(self, window: int = 8192) -> None:
+        self._lock = threading.Lock()
+        self._window = window
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.queue_wait = LatencyMeter(self._window)
+            self.latency = LatencyMeter(self._window)
+            self.batch_hist: Dict[int, int] = {}
+            self.requests = 0
+            self.images = 0
+            self.valid_rows = 0
+            self.padded_rows = 0
+            self.device_calls = 0
+            self.compiles = 0
+            self.compiles_by_bucket: Dict[int, int] = {}
+            self.compile_s = 0.0
+            self.cache_hits = 0
+            self.rejected = 0
+            self._t0 = time.monotonic()
+
+    # -- engine-side updates -------------------------------------------
+    def record_compile(self, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            self.compiles_by_bucket[bucket] = \
+                self.compiles_by_bucket.get(bucket, 0) + 1
+            self.compile_s += float(seconds)
+
+    def record_cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_dispatch(self, bucket: int, valid: int,
+                        queue_waits) -> None:
+        with self._lock:
+            self.device_calls += 1
+            self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
+            self.valid_rows += valid
+            self.padded_rows += bucket - valid
+            for w in queue_waits:
+                self.queue_wait.update(w)
+
+    def record_done(self, n_requests: int, n_images: int,
+                    latencies) -> None:
+        with self._lock:
+            self.requests += n_requests
+            self.images += n_images
+            for lat in latencies:
+                self.latency.update(lat)
+
+    # -- reads ---------------------------------------------------------
+    def pad_efficiency_rows(self) -> tuple:
+        """(valid_rows, padded_rows) so far."""
+        with self._lock:
+            return self.valid_rows, self.padded_rows
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict of everything above (plus derived rates)."""
+        with self._lock:
+            elapsed = max(1e-9, time.monotonic() - self._t0)
+            rows = self.valid_rows + self.padded_rows
+            return {
+                "requests": self.requests,
+                "images": self.images,
+                "device_calls": self.device_calls,
+                "throughput_images_per_sec": round(self.images / elapsed, 2),
+                "queue_wait_ms": self.queue_wait.percentiles_ms(),
+                "latency_ms": self.latency.percentiles_ms(),
+                "batch_hist": {str(k): v for k, v in
+                               sorted(self.batch_hist.items())},
+                "pad_efficiency": round(self.valid_rows / rows, 4)
+                                  if rows else None,
+                "compiles": self.compiles,
+                "compiles_by_bucket": {str(k): v for k, v in
+                                       sorted(self.compiles_by_bucket
+                                              .items())},
+                "compile_s": round(self.compile_s, 3),
+                "executable_cache_hits": self.cache_hits,
+                "rejected": self.rejected,
+                "elapsed_s": round(elapsed, 3),
+            }

@@ -175,6 +175,11 @@ class Trainer:
             with open(os.path.join(self.ckpt.root, "config.json"), "w") as f:
                 json.dump(dataclasses.asdict(resolved), f, indent=2,
                           default=str)
+            # Class-name sidecar: online serving (tpuic.serve) has no fold
+            # tree to derive display names from at request time.
+            with open(os.path.join(self.ckpt.root,
+                                   "class_to_idx.json"), "w") as f:
+                json.dump(self.train_ds.class_to_idx, f, indent=2)
         # SIGTERM (pod preemption / scheduler eviction) -> finish the
         # current step, flush a 'latest' checkpoint, return cleanly
         # (runtime/preemption.py). The handler is installed for the span of
