@@ -1,0 +1,677 @@
+"""Fault-injection suite (ISSUE 2 acceptance): every recovery path of the
+fault-tolerance layer driven deterministically on CPU via tpuic.runtime.faults
+— non-finite step guard + rollback, checkpoint kill/corruption ladder, sample
+quarantine, serve error isolation, and SIGTERM drain."""
+
+import json
+import os
+import signal
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                          OptimConfig, RunConfig)
+from tpuic.runtime import faults
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_train_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No armed fault may leak between tests (the plan is process-global)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- harness itself ---------------------------------------------------------
+def test_fault_plan_spec_and_counting():
+    plan = faults.FaultPlan("nan_batch@3-5,sigterm@7,ckpt_kill*2")
+    assert not plan.fire("nan_batch", step=2)
+    assert plan.fire("nan_batch", step=3)
+    assert plan.fire("nan_batch", step=5)
+    assert not plan.fire("nan_batch", step=6)
+    assert plan.fire("sigterm", step=7) and not plan.fire("sigterm", step=8)
+    assert plan.fire("ckpt_kill") and plan.fire("ckpt_kill")
+    assert not plan.fire("ckpt_kill")  # *2 exhausted
+    assert not plan.fire("unarmed")
+    assert plan.fired["nan_batch"] == 2
+
+
+# -- non-finite step guard --------------------------------------------------
+def _tiny_step(skip_nonfinite=True, ema_decay=0.0):
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(3)(x.reshape((x.shape[0], -1)))
+
+    ocfg = OptimConfig(optimizer="sgd", learning_rate=0.1, class_weights=(),
+                       milestones=(), skip_nonfinite=skip_nonfinite,
+                       ema_decay=ema_decay)
+    mcfg = ModelConfig(name="tiny", num_classes=3, dtype="float32")
+    state = create_train_state(Tiny(), make_optimizer(ocfg),
+                               jax.random.key(0), (4, 8, 8, 3),
+                               ema=ema_decay > 0)
+    return state, make_train_step(ocfg, mcfg, mesh=None)
+
+
+def _batch(poison=False):
+    img = jnp.ones((4, 8, 8, 3), jnp.float32)
+    if poison:
+        img = img * np.float32("nan")
+    return {"image": img, "label": jnp.array([0, 1, 2, 0]),
+            "mask": jnp.ones((4,), jnp.float32)}
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(tree))]
+
+
+def test_nan_batch_skipped_state_unchanged_zero_recompiles():
+    """The tentpole contract: a NaN batch yields an UNCHANGED state
+    (params, opt_state, step) + skipped flag, inside the one compiled
+    program — the executable cache stays at exactly 1 entry."""
+    state, step = _tiny_step()
+    state, m = step(state, _batch())
+    assert float(m["skipped"]) == 0.0 and int(m["skip_count"]) == 0
+    before_p = _leaves(state.params)
+    before_o = _leaves(state.opt_state)
+    before_step = int(jax.device_get(state.step))
+    state, m = step(state, _batch(poison=True))
+    assert float(m["skipped"]) == 1.0 and int(m["skip_count"]) == 1
+    assert not np.isfinite(float(m["loss"]))  # metric reports honestly
+    for a, b in zip(before_p, _leaves(state.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(before_o, _leaves(state.opt_state)):
+        np.testing.assert_array_equal(a, b)
+    assert int(jax.device_get(state.step)) == before_step
+    # streak counts up, then resets to 0 on the next finite step
+    state, m = step(state, _batch(poison=True))
+    assert int(m["skip_count"]) == 2
+    state, m = step(state, _batch())
+    assert int(m["skip_count"]) == 0 and float(m["skipped"]) == 0.0
+    for a, b in zip(before_p, _leaves(state.params)):
+        assert not np.array_equal(a, b) or a.size == 0  # finite step moved
+    assert step._cache_size() == 1  # ZERO recompiles across skip/apply
+
+
+def test_nan_guard_holds_ema_and_stats():
+    state, step = _tiny_step(ema_decay=0.9)
+    state, _ = step(state, _batch())
+    ema_before = _leaves(state.ema_params)
+    state, m = step(state, _batch(poison=True))
+    assert float(m["skipped"]) == 1.0
+    for a, b in zip(ema_before, _leaves(state.ema_params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_guard_disabled_poisons_state():
+    """skip_nonfinite=False is the reference behavior: NaN propagates into
+    params (documented footgun — what the guard exists to prevent)."""
+    state, step = _tiny_step(skip_nonfinite=False)
+    state, m = step(state, _batch(poison=True))
+    assert "skipped" not in m
+    assert any(not np.isfinite(a).all() for a in _leaves(state.params))
+
+
+# -- checkpoint kill + integrity ladder ------------------------------------
+def _ckpt_state(seed=0):
+    import flax.linen as nn
+
+    class Small(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(3)(x.reshape((x.shape[0], -1)))
+
+    ocfg = OptimConfig(optimizer="adam", learning_rate=1e-3, class_weights=(),
+                       milestones=())
+    return create_train_state(Small(), make_optimizer(ocfg),
+                              jax.random.key(seed), (2, 8, 8, 3))
+
+
+def _a_file_of(track_dir):
+    for dirpath, _, files in sorted(os.walk(track_dir)):
+        for f in sorted(files):
+            return os.path.join(dirpath, f)
+    raise AssertionError(f"no files under {track_dir}")
+
+
+def test_kill_during_save_latest_still_restores(tmp_path):
+    """SIGKILL-mid-write simulation (satellite: checkpoint atomicity): the
+    staged save dies before its commit rotation — the previously committed
+    'latest' must restore untouched."""
+    from tpuic.checkpoint.manager import CheckpointManager
+
+    a, b = _ckpt_state(0), _ckpt_state(1)
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_latest(a, epoch=1, best_score=10.0)
+    mgr.wait()
+    faults.arm("ckpt_kill")
+    mgr.save_latest(b, epoch=2, best_score=20.0)
+    with pytest.raises(faults.InjectedFault):
+        mgr.wait()
+    faults.reset()
+    # A fresh manager (the restarted process) sees the epoch-1 save whole.
+    mgr2 = CheckpointManager(str(tmp_path), "m")
+    restored, epoch, best = mgr2.restore_into(_ckpt_state(2), "latest")
+    assert (epoch, best) == (2, 10.0)  # epoch 1 save -> resume at 2
+    for x, y in zip(_leaves(a.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+    # The interrupted save can simply be retried.
+    mgr2.save_latest(b, epoch=2, best_score=20.0)
+    mgr2.wait()
+    restored, epoch, best = mgr2.restore_into(_ckpt_state(2), "latest")
+    assert (epoch, best) == (3, 20.0)
+    for x, y in zip(_leaves(b.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_integrity_ladder_every_rung(tmp_path):
+    """Corruption walks the ladder: latest -> best -> previous-latest, and
+    a corrupt MANIFEST counts as a corrupt rung (satellite)."""
+    from tpuic.checkpoint.manager import CheckpointManager
+
+    a, b, c = _ckpt_state(0), _ckpt_state(1), _ckpt_state(2)
+    mgr = CheckpointManager(str(tmp_path), "m")
+    mgr.save_best(c, epoch=0, best_score=5.0)
+    mgr.save_latest(a, epoch=1, best_score=5.0)
+    mgr.save_latest(b, epoch=2, best_score=5.0)  # latest=b(e2), prev=a(e1)
+    mgr.wait()
+    ok, detail = mgr.verify_track("latest")
+    assert ok, detail
+
+    # Rung 1: healthy latest wins.
+    restored, epoch, _ = mgr.restore_into(_ckpt_state(9))
+    assert mgr.last_restore_rung == "latest" and epoch == 3
+
+    # Rung 2: flip bytes in latest -> manifest catches it -> best.
+    faults.corrupt_file(_a_file_of(os.path.join(mgr.root, "latest")))
+    restored, epoch, _ = mgr.restore_into(_ckpt_state(9))
+    assert mgr.last_restore_rung == "best" and epoch == 1
+    for x, y in zip(_leaves(c.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+
+    # Rung 3: ALSO corrupt best's manifest (garbage JSON) -> latest.prev.
+    with open(os.path.join(mgr.root, "best.manifest.json"), "w") as f:
+        f.write("{not json")
+    restored, epoch, _ = mgr.restore_into(_ckpt_state(9))
+    assert mgr.last_restore_rung == "latest.prev" and epoch == 2
+    for x, y in zip(_leaves(a.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+
+    # Every rung corrupt: loud failure, never a silent from-scratch run.
+    faults.corrupt_file(_a_file_of(os.path.join(mgr.root, "latest.prev")))
+    with pytest.raises(RuntimeError, match="every integrity-ladder rung"):
+        mgr.restore_into(_ckpt_state(9))
+
+
+# -- sample quarantine ------------------------------------------------------
+def _folder_with_truncated_jpeg(root, per_class=4):
+    """Synthetic ImageFolder + one deliberately truncated JPEG, sized so
+    one epoch at global_batch=3 has no wrap padding (9 samples)."""
+    from PIL import Image
+
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=per_class,
+                               size=16)
+    bad = os.path.join(root, "train", "a", "zz_trunc.jpg")
+    rng = np.random.default_rng(0)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8)).save(
+        bad, "JPEG")
+    faults.truncate_file(bad, keep=60)
+    return bad
+
+
+def test_truncated_jpeg_completes_epoch_with_quarantine_1(tmp_path):
+    """The satellite's regression: a truncated file used to propagate an
+    OSError out of the producer thread and abort the epoch. Now the epoch
+    completes and the quarantine counter reads exactly 1."""
+    from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pipeline import Loader
+
+    root = str(tmp_path / "data")
+    bad = _folder_with_truncated_jpeg(root)
+    cfg = DataConfig(data_dir=root, resize_size=16, pack=False,
+                     quarantine_backoff_s=0.0)
+    ds = ImageFolderDataset(root, "train", 16, cfg)
+    loader = Loader(ds, 3, None, num_workers=2, seed=0)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 3  # 9 samples / 3 — epoch COMPLETED
+    assert loader.quarantine_count == 1
+    assert ds.quarantined == {bad: 1}
+    # Replacement keeps the label honest: same class as the corrupt file.
+    idx = [p for p, _ in ds.samples].index(bad)
+    _, label, _ = ds.load(idx)
+    assert label == ds.class_to_idx["a"]
+
+
+def test_quarantine_off_fails_fast(tmp_path):
+    from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pipeline import Loader
+
+    root = str(tmp_path / "data")
+    _folder_with_truncated_jpeg(root)
+    cfg = DataConfig(data_dir=root, resize_size=16, pack=False,
+                     quarantine=False, quarantine_retries=0)
+    ds = ImageFolderDataset(root, "train", 16, cfg)
+    with pytest.raises(OSError):
+        list(Loader(ds, 3, None, num_workers=2).epoch(0))
+
+
+def test_injected_decode_error_quarantines_deterministically(tmp_path):
+    from tpuic.data.folder import ImageFolderDataset
+
+    root = str(tmp_path / "data")
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    make_synthetic_imagefolder(root, classes=("a", "b"), per_class=3,
+                               size=16)
+    cfg = DataConfig(data_dir=root, resize_size=16, pack=False,
+                     quarantine_backoff_s=0.0)
+    ds = ImageFolderDataset(root, "train", 16, cfg)
+    # Persistent fault (no times cap): the retry fails too -> quarantine.
+    faults.arm("decode_error", steps=1)
+    img, label, _ = ds.load(1)
+    assert ds.quarantine_count == 1
+    assert label == ds.samples[1][1]  # same-class replacement
+    assert img.shape == (16, 16, 3)
+    # Unarmed index: clean load, no counting.
+    ds.load(0)
+    assert ds.quarantine_count == 1
+    # Transient fault (times=1): the backoff retry RECOVERS — no
+    # quarantine event (the file-mid-copy case).
+    faults.reset()
+    faults.arm("decode_error", steps=0, times=1)
+    ds.load(0)
+    assert ds.quarantine_count == 1
+
+
+def test_pack_build_quarantines_truncated_file(tmp_path):
+    from tpuic.data.folder import ImageFolderDataset
+    from tpuic.data.pack import pack_dataset
+
+    root = str(tmp_path / "data")
+    bad = _folder_with_truncated_jpeg(root)
+    # A SECOND adjacent corrupt file in the same class: corruption is
+    # correlated (interrupted copies), so the first replacement candidate
+    # may itself be corrupt — the cascade must walk past it.
+    from PIL import Image
+    bad2 = os.path.join(root, "train", "a", "zz_trunc2.jpg")
+    rng = np.random.default_rng(1)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8)).save(
+        bad2, "JPEG")
+    faults.truncate_file(bad2, keep=60)
+    cfg = DataConfig(data_dir=root, resize_size=16, pack=True,
+                     quarantine_backoff_s=0.0)
+    ds = ImageFolderDataset(root, "train", 16, cfg)
+    packed = pack_dataset(ds, str(tmp_path / "cache"), verbose=False)
+    assert packed.quarantine_count == 2
+    # The packed rows carry their REPLACEMENT's label AND image id —
+    # identical semantics to the unpacked path, so per-sample records
+    # keyed by id agree between packed and decode runs.
+    paths = [p for p, _ in ds.samples]
+    for corrupt in (bad, bad2):
+        idx = paths.index(corrupt)
+        assert int(packed._labels[idx]) == ds.class_to_idx["a"]
+        rid = packed.image_id(idx)
+        assert rid not in ("zz_trunc", "zz_trunc2")
+        assert rid in {ds.image_id(i) for i, (p, _) in
+                       enumerate(ds.samples) if p not in (bad, bad2)}
+
+
+# -- trainer end-to-end: consecutive skips -> rollback -> completion --------
+def _trainer_config(root, tmp_path, **run_kw):
+    run = dict(epochs=2, ckpt_dir=str(tmp_path / "cp"), save_period=1,
+               resume=False, log_every_steps=1, skip_threshold=2,
+               max_rollbacks=2, rollback_rewarm_steps=4)
+    run.update(run_kw)
+    return Config(
+        data=DataConfig(data_dir=root, resize_size=16, batch_size=8,
+                        num_workers=2, pack=False),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="adam", learning_rate=1e-3,
+                          class_weights=(), milestones=()),
+        run=RunConfig(**run),
+        mesh=MeshConfig(),
+    )
+
+
+def _make_trainer(cfg, **kw):
+    """Trainer pinned to ONE device with SYNCHRONOUS checkpoint writes.
+
+    Two stabilizations for this 2-core host, neither touching the logic
+    under test (guard/rollback/ladder are mesh- and async-agnostic):
+    the 8-fake-device SPMD step's scalar all-reduces can wedge in a
+    7-of-8 collective rendezvous when the cores are oversubscribed
+    (observed: AllReduceParticipantData ... may be stuck, then SIGABRT),
+    and an async-Orbax write overlapping CPU training is the documented
+    mid-suite segfault that slow-marked test_preemption."""
+    import orbax.checkpoint as ocp
+
+    from tpuic.runtime.mesh import make_mesh
+    from tpuic.train.loop import Trainer
+    mesh = make_mesh(cfg.mesh, jax.devices()[:1])
+    trainer = Trainer(cfg, mesh=mesh, **kw)
+    trainer.ckpt._ckptr = ocp.PyTreeCheckpointer()
+    return trainer
+
+
+@pytest.mark.slow  # full fit()s on this 2-core host destabilize mid-suite
+# (async-Orbax teardown aborts — the same reason test_trainer's fit tests
+# and test_preemption are slow-marked); passes standalone. The tier-1
+# coverage of the same logic: the in-graph guard unit tests above + the
+# detection-threshold unit below.
+def test_nan_streak_rolls_back_and_training_completes(tmp_path, devices8):
+    """Acceptance: epoch 0 trains clean and checkpoints; epoch 1 opens with
+    an injected NaN storm; the skip streak trips skip_threshold, the
+    Trainer restores the epoch-0 checkpoint (integrity-verified), re-warms
+    the LR, replays epoch 1 clean, and fit() runs to completion with
+    finite weights."""
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.train.loop import Trainer
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b", "c"), per_class=8,
+                               size=16)
+    trainer = _make_trainer(_trainer_config(root, tmp_path),
+                            log_dir=str(tmp_path / "logs"))
+    steps = trainer.train_loader.steps_per_epoch()
+    assert steps >= 3
+    # Poison every step from epoch 1's first (global step == steps) on,
+    # but at most 3 firings: detection consumes them, the post-rollback
+    # replay of epoch 1 then runs clean.
+    faults.arm("nan_batch", steps=range(steps, 10_000), times=3)
+    best = trainer.fit()
+    assert trainer.rollbacks == 1
+    assert faults.fired("nan_batch") == 3
+    assert 0.0 <= best <= 100.0
+    for leaf in _leaves(trainer.state.params):
+        assert np.isfinite(leaf).all()
+    # Both epochs' validations ran (the poisoned epoch was REPLAYED, not
+    # dropped) and the streak made it into the metrics log.
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "logs" / "metrics.jsonl")]
+    assert sum(1 for r in recs if "val_accuracy" in r) == 2
+    assert any(r.get("skipped_streak", 0) >= 2 for r in recs)
+
+
+@pytest.mark.slow  # fit()-based: see test_nan_streak_rolls_back note
+def test_rollback_without_checkpoint_is_loud(tmp_path, devices8):
+    """A NaN storm before ANY checkpoint exists must abort with a clear
+    error, not loop or train on garbage."""
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.train.loop import Trainer
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b", "c"), per_class=8,
+                               size=16)
+    trainer = _make_trainer(_trainer_config(root, tmp_path))
+    faults.arm("nan_batch")  # every step, from step 0
+    with pytest.raises(RuntimeError, match="nothing to roll back to"):
+        trainer.fit()
+
+
+def test_drain_detects_streak_and_flags_rollback(tmp_path):
+    """Tier-1 unit for the rollback WATCHDOG (the fit()-scale end-to-end
+    lives in the slow tests): the deferred drain reads the in-graph
+    streak, logs it, and flips the rollback flag exactly at threshold."""
+    import types
+
+    from tpuic.metrics.logging import MetricLogger
+    from tpuic.metrics.meters import AverageMeter
+    from tpuic.train.loop import Trainer
+
+    cfg = Config(run=RunConfig(skip_threshold=3, rollback=True))
+    host = types.SimpleNamespace(cfg=cfg, _rollback_pending=False,
+                                 logger=MetricLogger(str(tmp_path / "l")))
+    drain = Trainer._drain_train_log
+    bar = types.SimpleNamespace(set_description=lambda *a, **k: None)
+    losses = AverageMeter()
+    mk = lambda sc: {"loss": np.float32("nan"), "accuracy": np.float32(0.1),
+                     "skip_count": np.int32(sc)}
+    drain(host, (10, 1.0, mk(2)), losses, bar, epoch=0)
+    assert host._rollback_pending is False  # below threshold
+    drain(host, (11, 1.0, mk(3)), losses, bar, epoch=0)
+    assert host._rollback_pending is True   # at threshold
+    recs = [json.loads(ln)
+            for ln in open(tmp_path / "l" / "metrics.jsonl")]
+    assert [r.get("skipped_streak") for r in recs] == [2, 3]
+    # rollback=False never flags, whatever the streak.
+    host2 = types.SimpleNamespace(
+        cfg=Config(run=RunConfig(skip_threshold=3, rollback=False)),
+        _rollback_pending=False, logger=MetricLogger(None))
+    drain(host2, (12, 1.0, mk(9)), losses, bar, epoch=0)
+    assert host2._rollback_pending is False
+
+
+@pytest.mark.slow  # a full epoch of CPU training before the signal
+def test_sigterm_injection_flushes_latest_mid_epoch(tmp_path, devices8):
+    """faults 'sigterm' drives the real preemption path: the handler
+    latches, the loop breaks at the step boundary, and a step-exact
+    'latest' lands on disk."""
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.train.loop import Trainer
+
+    root = str(tmp_path / "data")
+    make_synthetic_imagefolder(root, classes=("a", "b", "c"), per_class=8,
+                               size=16)
+    trainer = _make_trainer(_trainer_config(root, tmp_path))
+    steps = trainer.train_loader.steps_per_epoch()
+    faults.arm("sigterm", steps=steps + 2)  # mid-epoch 1
+    trainer.fit()
+    mgr = trainer.ckpt
+    restored, epoch, _ = mgr.restore_into(trainer.state, "latest")
+    assert epoch == 1
+    assert mgr.last_restore_step_in_epoch == 2
+
+
+# -- serve: error isolation + SIGTERM drain ---------------------------------
+SIZE = 4
+
+
+def _sum_forward(variables, images):
+    return jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+
+
+def _engine(**kw):
+    from tpuic.serve.engine import InferenceEngine
+    kw.setdefault("forward_fn", _sum_forward)
+    kw.setdefault("variables", {})
+    kw.setdefault("image_size", SIZE)
+    kw.setdefault("buckets", (1, 2, 4, 8))
+    kw.setdefault("autostart", False)
+    return InferenceEngine(**kw)
+
+
+class _BoomArray:
+    """Looks like a [1,S,S,C] array; detonates when np materializes it."""
+    shape = (1, SIZE, SIZE, 3)
+
+    def __array__(self, *a, **k):
+        raise RuntimeError("boom: unmaterializable request")
+
+
+def test_dispatch_isolates_bad_request_from_batchmates():
+    """Satellite: one request failing the staging copy gets the exception
+    on ITS future; siblings coalesced into the same device batch still
+    dispatch and resolve."""
+    from tpuic.serve.engine import _Request
+
+    eng = _engine()
+    good1 = _Request(np.full((1, SIZE, SIZE, 3), 1, np.float32), Future())
+    bad = _Request(_BoomArray(), Future())
+    good2 = _Request(np.full((1, SIZE, SIZE, 3), 2, np.float32), Future())
+    inflight = eng._dispatch([good1, bad, good2])
+    assert inflight is not None
+    eng._resolve(inflight)
+    assert isinstance(bad.future.exception(), RuntimeError)
+    np.testing.assert_allclose(good1.future.result(timeout=1),
+                               [SIZE * SIZE * 3 * 1.0])
+    np.testing.assert_allclose(good2.future.result(timeout=1),
+                               [SIZE * SIZE * 3 * 2.0])
+
+
+def test_resolve_isolates_scatter_failure():
+    from tpuic.serve.engine import _Request
+
+    class EvilFuture(Future):
+        def set_result(self, result):
+            raise RuntimeError("scatter boom")
+
+    eng = _engine()
+    evil = _Request(np.ones((1, SIZE, SIZE, 3), np.float32), EvilFuture())
+    good = _Request(np.full((1, SIZE, SIZE, 3), 3, np.float32), Future())
+    inflight = eng._dispatch([evil, good])
+    eng._resolve(inflight)
+    assert isinstance(evil.future.exception(), RuntimeError)
+    np.testing.assert_allclose(good.future.result(timeout=1),
+                               [SIZE * SIZE * 3 * 3.0])
+
+
+def _serve_watch_files(tmp_path, n):
+    from PIL import Image
+    watch = tmp_path / "incoming"
+    watch.mkdir()
+    rng = np.random.default_rng(10)
+    for i in range(n):
+        Image.fromarray(rng.integers(0, 256, (SIZE, SIZE, 3),
+                                     np.uint8)).save(watch / f"im_{i}.png")
+    return watch
+
+
+def _stub_build_engine(args):
+    from tpuic.serve.engine import InferenceEngine
+
+    def fwd(variables, images):
+        s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+        probs = jax.nn.softmax(
+            jnp.stack([s, -s, jnp.zeros_like(s)], axis=-1), axis=-1)
+        return probs, jnp.argsort(-probs, axis=-1)
+
+    eng = InferenceEngine(forward_fn=fwd, variables={}, image_size=SIZE,
+                          input_dtype=np.uint8, buckets=(1, 2, 4, 8),
+                          max_wait_ms=5.0)
+    eng.warmup()
+    return eng, SIZE, 3, "stub"
+
+
+def _sigterm_when(cond, timeout=20.0):
+    """Deliver SIGTERM to this process as soon as ``cond()`` holds (or at
+    ``timeout`` as a backstop) — condition-triggered, NOT wall-clock-raced
+    against engine warmup time. A pre-installed no-op handler guards the
+    window before main() installs the real latch."""
+    prev = signal.signal(signal.SIGTERM, lambda *a: None)
+
+    def watch():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout and not cond():
+            time.sleep(0.02)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return prev
+
+
+def test_serve_sigterm_drains_and_exits(tmp_path, monkeypatch, capsys):
+    """Acceptance: SIGTERM to the serve CLI (non-``--once`` watch loop, the
+    run-forever mode) drains in-flight requests and exits 0 instead of
+    looping forever or dropping work."""
+    import tpuic.serve.__main__ as serve_main
+
+    watch = _serve_watch_files(tmp_path, 3)
+    monkeypatch.setattr(serve_main, "build_engine", _stub_build_engine)
+    out = tmp_path / "resp.jsonl"
+    # Signal once every request has been accepted AND answered — proving
+    # the loop would have kept serving forever without the latch.
+    done = lambda: (out.exists()
+                    and len(out.read_text().splitlines()) >= 3)
+    prev = _sigterm_when(done)
+    try:
+        rc = serve_main.main(["--watch", str(watch), "--out", str(out),
+                              "--num-classes", "3", "--poll-s", "0.05",
+                              "--drain-timeout", "10"])
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert rc == 0
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert {ln["id"] for ln in lines} == {f"im_{i}.png" for i in range(3)}
+    assert all("pred" in ln for ln in lines)  # drained, not dropped
+    assert "SIGTERM: draining" in capsys.readouterr().err
+
+
+def test_serve_stdin_mode_sigterm_drains(tmp_path, monkeypatch, capsys):
+    """stdin mode with a REAL pipe: requests are answered, and SIGTERM
+    interrupts the select-gated read loop (an idle blocked readline would
+    never observe the latch — the bug this loop shape exists to avoid)."""
+    import tpuic.serve.__main__ as serve_main
+    from PIL import Image
+
+    img_path = tmp_path / "one.png"
+    Image.fromarray(np.random.default_rng(3).integers(
+        0, 256, (SIZE, SIZE, 3), np.uint8)).save(img_path)
+    monkeypatch.setattr(serve_main, "build_engine", _stub_build_engine)
+    out = tmp_path / "resp.jsonl"
+    r_fd, w_fd = os.pipe()
+    reader = os.fdopen(r_fd, "r")
+    writer = os.fdopen(w_fd, "w")
+    monkeypatch.setattr(serve_main.sys, "stdin", reader)
+    # BOTH requests in ONE write: a burst must be fully consumed even
+    # though select() sees only one readiness edge (regression: buffered
+    # lines invisible at the fd level stalled every request after the
+    # first).
+    writer.write(json.dumps({"id": "r1", "path": str(img_path)}) + "\n"
+                 + json.dumps({"id": "r2", "path": str(img_path)}) + "\n")
+    writer.flush()  # pipe stays OPEN: only SIGTERM can end the loop
+    done = lambda: (out.exists()
+                    and len(out.read_text().splitlines()) >= 2)
+    prev = _sigterm_when(done)
+    try:
+        rc = serve_main.main(["--out", str(out), "--num-classes", "3",
+                              "--drain-timeout", "10"])
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        writer.close()
+        reader.close()
+    assert rc == 0
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert {ln["id"] for ln in lines} == {"r1", "r2"}
+    assert all("pred" in ln for ln in lines)
+    assert "SIGTERM: draining" in capsys.readouterr().err
+
+
+def test_serve_drain_timeout_fails_stragglers(tmp_path, monkeypatch, capsys):
+    """A wedged device call ('hang_device' injection) can't hold shutdown
+    hostage: past --drain-timeout every unresolved request gets an explicit
+    error line and the driver exits."""
+    import tpuic.serve.__main__ as serve_main
+
+    watch = _serve_watch_files(tmp_path, 2)
+    monkeypatch.setattr(serve_main, "build_engine", _stub_build_engine)
+    faults.arm("hang_device", param=2.5)
+    out = tmp_path / "resp.jsonl"
+    # Signal once the batcher is INSIDE the hanging device call — the
+    # submitted requests are then provably in flight and unresolved.
+    prev = _sigterm_when(lambda: faults.fired("hang_device") > 0)
+    t0 = time.monotonic()
+    try:
+        rc = serve_main.main(["--watch", str(watch), "--out", str(out),
+                              "--num-classes", "3", "--poll-s", "0.05",
+                              "--drain-timeout", "0.2"])
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert rc == 0
+    assert time.monotonic() - t0 < 15.0  # returned promptly, not hostage
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert {ln["id"] for ln in lines} == {"im_0.png", "im_1.png"}
+    assert any("drain timeout" in ln.get("error", "") for ln in lines)

@@ -21,12 +21,23 @@ rank-0 ``torch.save`` of a replicated state_dict):
   at 0 anyway (train.py:149-150 vs 161 — latent bug); here the trainer resumes
   from whichever track (latest/best) carries the highest epoch, so a crash
   long after the last val improvement doesn't replay dozens of epochs.
+- **Atomic commit + integrity ladder** (docs/robustness.md): every save is
+  staged to ``{track}.new`` and only *rotated* into ``{track}`` (previous
+  save kept as ``{track}.prev``) after the async write finishes — a SIGKILL
+  mid-write can never leave a half-checkpoint as ``latest``. At commit a
+  manifest sidecar (``{track}.manifest.json``: per-file sizes + CRC32s,
+  step/epoch) records what was written; ``restore_into`` verifies it and on
+  any mismatch walks the fallback ladder newest -> other track -> their
+  ``.prev`` rungs, logging which rung was taken (``last_restore_rung``).
+  Checkpoints without a manifest (pre-ladder) restore unverified, as before.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -34,6 +45,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from tpuic.metrics.logging import host0_print
+from tpuic.runtime import faults as _faults
 
 
 def _flatten(tree, prefix=()) -> Dict[Tuple, Any]:
@@ -94,6 +106,51 @@ def lenient_restore(current: Dict, restored: Dict) -> Tuple[Dict, int, int]:
 RESUME_META_KEYS = ("step_in_epoch", "global_batch", "data_seed", "data_len")
 GEOMETRY_META_KEYS = ("global_batch", "data_seed", "data_len")
 
+_MANIFEST_VERSION = 1
+
+
+def _dir_manifest(path: str) -> Dict[str, Any]:
+    """{relpath: [size, crc32]} for every file under ``path``, in sorted
+    order — the content fingerprint the integrity ladder verifies. CRC32
+    (not a cryptographic hash) on purpose: the threat model is bit-rot and
+    torn writes, not adversaries, and checkpoints are hundreds of MB."""
+    files: Dict[str, Any] = {}
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            fp = os.path.join(dirpath, fn)
+            rel = os.path.relpath(fp, path).replace(os.sep, "/")
+            crc = 0
+            size = 0
+            with open(fp, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+                    size += len(chunk)
+            files[rel] = [size, crc]
+    return files
+
+
+def _atomic_json(path: str, obj) -> None:
+    """tmp + rename so readers never see a half-written sidecar."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _remove(path: str) -> None:
+    """Remove a file or directory tree, tolerating absence."""
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    else:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
 
 class CheckpointManager:
     """best/latest checkpoint tracks under ``{ckpt_dir}/{name}``."""
@@ -107,6 +164,11 @@ class CheckpointManager:
             self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         except Exception:  # pragma: no cover — very old orbax
             self._ckptr = ocp.PyTreeCheckpointer()
+        # The one save whose async write may still be in flight: its
+        # (track, sidecar metadata). wait() commits it — manifest, then
+        # the .new -> track rotation — so a reader that waited always sees
+        # either the previous complete checkpoint or the new complete one.
+        self._pending: Optional[Dict[str, Any]] = None
         if jax.process_index() == 0:
             os.makedirs(self.root, exist_ok=True)
 
@@ -157,33 +219,100 @@ class CheckpointManager:
         return payload
 
     def wait(self) -> None:
-        """Block until any in-flight async save has committed."""
+        """Block until any in-flight async save has COMMITTED: the write
+        finishes, the manifest is computed over the staged bytes, and the
+        staged dir is rotated into its track (previous save -> .prev).
+
+        Every reader (newest_track / restore_into) and every new save goes
+        through here first, so a checkpoint becomes visible atomically or
+        not at all. ``faults`` point ``ckpt_kill`` fires between the
+        finished write and the rotation — the SIGKILL-mid-save simulation:
+        the committed track must be untouched by the aborted save."""
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        if jax.process_index() != 0:
+            # Single-writer rotation: every host wrote its shards above,
+            # host 0 owns the filesystem commit (same single-writer rule
+            # as the sidecars; multi-host runs share the checkpoint FS).
+            # Hold at the commit barrier so no host reads the track
+            # before host 0's rotation lands.
+            self._commit_barrier()
+            return
+        track = pending["track"]
+        path = os.path.join(self.root, track)
+        new = path + ".new"
+        if not os.path.isdir(new):
+            # Staged save vanished (failed write) — nothing to commit, but
+            # the other hosts still entered the barrier for this save.
+            self._commit_barrier()
+            return
+        manifest = {"version": _MANIFEST_VERSION,
+                    "epoch": pending["epoch"],
+                    "step_in_epoch": pending["step_in_epoch"],
+                    "step": pending["step"],
+                    "files": _dir_manifest(new)}
+        _atomic_json(new + ".manifest.json", manifest)
+        if _faults.fire("ckpt_kill"):
+            raise _faults.InjectedFault(
+                f"injected kill before committing checkpoint '{track}'")
+        # Rotation: previous committed save survives as {track}.prev (the
+        # ladder's last rung). Plain renames — no data copies. The brief
+        # window between the two renames can leave only .prev on disk; the
+        # restore ladder includes .prev rungs precisely so that window is
+        # recoverable too.
+        prev = path + ".prev"
+        for suffix in ("", ".manifest.json", ".meta.json"):
+            _remove(prev + suffix)
+        if os.path.isdir(path):
+            os.rename(path, prev)
+            for suffix in (".manifest.json", ".meta.json"):
+                if os.path.exists(path + suffix):
+                    os.replace(path + suffix, prev + suffix)
+        os.rename(new, path)
+        os.replace(new + ".manifest.json", path + ".manifest.json")
+        # Resume sidecar: lets resume pick the newest track without a full
+        # restore of both.
+        _atomic_json(path + ".meta.json",
+                     {k: pending[k] for k in
+                      ("epoch", "best_score") + RESUME_META_KEYS})
+        self._commit_barrier()
+
+    @staticmethod
+    def _commit_barrier() -> None:
+        """Cross-host rendezvous after a commit rotation: wait() is
+        collective (every host calls it once per save, same order — the
+        discipline Orbax's own async commit already demands), so pairing a
+        barrier on the pending-save path keeps hosts from reading a track
+        host 0 is still renaming. Free on single-process runs. NOTE: a
+        host-0 crash mid-rotation (or an armed 'ckpt_kill') strands the
+        other hosts here until the scheduler reaps them — the same failure
+        semantics as a host dying inside any other collective."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("tpuic_ckpt_commit")
 
     def _save(self, track: str, state, epoch: int, best_score: float,
               step_in_epoch: int = -1, global_batch: int = -1,
               data_seed: int = -1, data_len: int = -1) -> None:
-        path = os.path.join(self.root, track)
         self.wait()  # one in-flight save at a time; also orders best/latest
-        self._ckptr.save(path,
-                         self._payload(state, epoch, best_score,
-                                       step_in_epoch=step_in_epoch,
-                                       global_batch=global_batch,
-                                       data_seed=data_seed,
-                                       data_len=data_len),
+        # Stage to {track}.new; wait() rotates it into {track} on commit.
+        payload = self._payload(state, epoch, best_score,
+                                step_in_epoch=step_in_epoch,
+                                global_batch=global_batch,
+                                data_seed=data_seed, data_len=data_len)
+        self._ckptr.save(os.path.join(self.root, f"{track}.new"), payload,
                          force=True)
-        if jax.process_index() == 0:
-            # Sidecar: lets resume pick the newest track without a full
-            # restore of both. Written after save() so an async crash
-            # mid-write can at worst leave a stale (not future) epoch.
-            with open(os.path.join(self.root, f"{track}.meta.json"), "w") as f:
-                json.dump({"epoch": int(epoch),
-                           "best_score": float(best_score),
-                           "step_in_epoch": int(step_in_epoch),
-                           "global_batch": int(global_batch),
-                           "data_seed": int(data_seed),
-                           "data_len": int(data_len)}, f)
+        self._pending = {"track": track, "epoch": int(epoch),
+                         "best_score": float(best_score),
+                         "step_in_epoch": int(step_in_epoch),
+                         "global_batch": int(global_batch),
+                         "data_seed": int(data_seed),
+                         "data_len": int(data_len),
+                         # reuse _payload's device_get — one sync per save
+                         "step": int(payload["meta"]["step"])}
 
     def save_best(self, state, epoch: int, best_score: float) -> None:
         """Reference train.py:173-180 — on val-accuracy improvement."""
@@ -280,21 +409,51 @@ class CheckpointManager:
             int(meta.get(k, -1)) for k in GEOMETRY_META_KEYS)
         return epoch, best, sie
 
+    def verify_track(self, track: str) -> Tuple[bool, str]:
+        """Check a track's on-disk bytes against its commit manifest.
+
+        Returns (ok, detail). Missing directory -> not ok. Missing
+        manifest -> ok-but-unverified (pre-ladder checkpoint: nothing to
+        check against, same trust level those checkpoints always had).
+        Unreadable/corrupt manifest, or any file added, missing, resized,
+        or failing its CRC -> not ok."""
+        path = os.path.join(self.root, track)
+        mpath = path + ".manifest.json"
+        if not os.path.isdir(path):
+            return False, "missing"
+        if not os.path.exists(mpath):
+            return True, "no manifest (pre-ladder checkpoint, unverified)"
+        try:
+            with open(mpath) as f:
+                expected = json.load(f)["files"]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return False, f"unreadable manifest: {e}"
+        live = _dir_manifest(path)
+        if live == expected:
+            return True, f"verified {len(live)} files"
+        for rel in sorted(set(expected) | set(live)):
+            if rel not in live:
+                return False, f"missing file {rel}"
+            if rel not in expected:
+                return False, f"unexpected file {rel}"
+            if live[rel] != expected[rel]:
+                return False, (f"checksum mismatch in {rel} "
+                               f"(expected {expected[rel]}, got {live[rel]})")
+        return False, "manifest mismatch"  # pragma: no cover — unreachable
+
     def restore_into(self, state, track: Optional[str] = None):
-        """Lenient restore of ``state`` (reference train.py:132-153).
+        """Verified restore of ``state`` through the integrity ladder.
 
-        ``track=None`` restores the newest of latest/best. Returns
-        (state, start_epoch, best_score); (state, 0, 0.0) when no checkpoint
-        exists — mirroring the reference's probe at train.py:136. Optimizer
-        state is restored only on a FULL param match (a partial /
-        cross-architecture load makes saved moments meaningless).
-
-        Two paths: an exact-structure checkpoint restores straight into the
-        live shardings (no host gather — each host reads only its shards);
-        anything else (architecture drift, partial checkpoints) falls back
-        to a host-side key-intersection merge, the reference's semantics
-        (train.py:143-148).
-        """
+        ``track=None`` starts at the newest of latest/best and falls back
+        newest -> other track -> their ``.prev`` rotations on corruption
+        (manifest mismatch) or a failed read, logging each rung skipped;
+        an explicit ``track`` ladders only through that track and its
+        ``.prev``. Returns (state, start_epoch, best_score);
+        (state, 0, 0.0) when no checkpoint exists — mirroring the
+        reference's probe at train.py:136. Raises RuntimeError when
+        checkpoints exist but EVERY rung is corrupt — training silently
+        restarting from scratch would be worse than stopping.
+        ``last_restore_rung`` records the rung actually used."""
         self.wait()  # don't read a track an async save is still writing
         # (n_loaded, n_total) of the last restore's param-leaf merge; None
         # for the sharded fast path (exact structure = full load). Lets
@@ -314,13 +473,56 @@ class CheckpointManager:
         # that report provenance (predict) regardless of which restore
         # branch ran.
         self.last_restore_meta = None
+        # The ladder rung the restore actually came from (None: nothing
+        # restored). != the requested track when the ladder fell back.
+        self.last_restore_rung = None
         if track is None:
-            track = self.newest_track()
-            if track is None:
-                return state, 0, 0.0
-        path = os.path.join(self.root, track)
-        if not os.path.isdir(path):
+            primary = self.newest_track() or "latest"
+            other = "best" if primary == "latest" else "latest"
+            rungs = [primary, other, primary + ".prev", other + ".prev"]
+        else:
+            rungs = [track, track + ".prev"]
+        rungs = [t for t in rungs
+                 if os.path.isdir(os.path.join(self.root, t))]
+        if not rungs:
             return state, 0, 0.0
+        failures = []
+        for i, rung in enumerate(rungs):
+            ok, detail = self.verify_track(rung)
+            if not ok:
+                host0_print(f"[ckpt] integrity: '{rung}' failed "
+                            f"verification ({detail}) — trying next rung")
+                failures.append(f"{rung}: {detail}")
+                continue
+            try:
+                out = self._restore_track(state, rung)
+            except Exception as e:
+                host0_print(f"[ckpt] restore of '{rung}' failed "
+                            f"({type(e).__name__}: {e}) — trying next rung")
+                failures.append(f"{rung}: {type(e).__name__}: {e}")
+                continue
+            self.last_restore_rung = rung
+            if i > 0:
+                host0_print(f"[ckpt] integrity ladder: restored from rung "
+                            f"'{rung}' (skipped {i}: "
+                            + "; ".join(failures) + ")")
+            return out
+        raise RuntimeError(
+            "no restorable checkpoint: every integrity-ladder rung failed "
+            "(" + "; ".join(failures) + ")")
+
+    def _restore_track(self, state, track: str):
+        """Restore one (existing, verified-or-unverifiable) track.
+
+        Two paths: an exact-structure checkpoint restores straight into the
+        live shardings (no host gather — each host reads only its shards);
+        anything else (architecture drift, partial checkpoints) falls back
+        to a host-side key-intersection merge, the reference's semantics
+        (train.py:143-148). Optimizer state is restored only on a FULL
+        param match (a partial / cross-architecture load makes saved
+        moments meaningless).
+        """
+        path = os.path.join(self.root, track)
         # Fast path: restore into the live shardings. Exact match required —
         # a cross-architecture checkpoint raises (shape/structure mismatch)
         # and drops to the lenient host-side path below. Tried twice:

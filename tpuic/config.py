@@ -71,6 +71,16 @@ class DataConfig:
     p_contrast: float = 0.05
     jitter_lo: float = 0.9
     jitter_hi: float = 1.1
+    # Sample quarantine (docs/robustness.md): a sample whose decode fails
+    # (truncated/corrupt file) is retried ``quarantine_retries`` times with
+    # ``quarantine_backoff_s`` between attempts (the file-mid-copy case),
+    # then replaced by a deterministic same-class substitute and counted —
+    # one corrupt file degrades the epoch by one sample instead of killing
+    # the producer thread (reference dp/loader.py has no handling at all).
+    # False restores fail-fast: the decode error propagates and aborts.
+    quarantine: bool = True
+    quarantine_retries: int = 1
+    quarantine_backoff_s: float = 0.05
 
     def resolved_val_batch_size(self) -> int:
         return self.val_batch_size or self.batch_size
@@ -211,6 +221,15 @@ class OptimConfig:
     # Use the fused Pallas cross-entropy kernel
     # (tpuic/kernels/cross_entropy.py) in the train step.
     fused_loss: bool = False
+    # Non-finite step guard (docs/robustness.md): the train step checks
+    # loss/grad-norm finiteness in-graph and applies the optimizer update
+    # under lax.cond — a NaN/Inf batch leaves params, opt_state, EMA, BN
+    # stats, and the step counter UNCHANGED and sets metrics['skipped'],
+    # with zero recompiles (the guard is part of the one compiled program).
+    # Large-batch regimes make transient non-finite steps an expected
+    # event, not an anomaly (arXiv:1711.04325). False removes the cond
+    # (bitwise the unguarded step; NaN then poisons state permanently).
+    skip_nonfinite: bool = True
 
     def __post_init__(self):
         if not 0.0 <= self.ema_decay < 1.0:
@@ -268,6 +287,23 @@ class RunConfig:
     # checkpoint, and returns instead of dying mid-epoch. The reference
     # loses everything since the last periodic save (SURVEY.md §5).
     handle_preemption: bool = True
+    # Rollback on a non-finite streak (docs/robustness.md): when the
+    # in-graph guard (OptimConfig.skip_nonfinite) has skipped this many
+    # CONSECUTIVE steps, the Trainer stops grinding forward, restores the
+    # last good checkpoint through the integrity ladder, and continues
+    # from there. Detection rides the deferred metrics drain, so latency
+    # is up to ~2 log intervals (log_every_steps). 0 disables detection.
+    skip_threshold: int = 10
+    rollback: bool = True
+    # Give up after this many rollbacks in one fit() — persistent
+    # non-finite data would otherwise loop restore->skip->restore forever.
+    max_rollbacks: int = 3
+    # After a rollback, ramp the LR linearly from ~0 back to the schedule
+    # over this many steps (loss-spike hygiene per the large-batch
+    # literature). Costs ONE retrace of the train step per rollback
+    # (the optimizer schedule changes); 0 keeps the plain schedule and
+    # stays retrace-free.
+    rollback_rewarm_steps: int = 0
     seed: int = 0
 
 

@@ -17,11 +17,21 @@ Re-design of reference ``ImageDataset`` (dp/loader.py:15-61):
   DistributedSampler shards overlap/miss samples. Here the index order is
   deterministic (sorted); shuffling belongs to the sampler (pipeline.py) with
   an epoch-folded global seed.
+- **Sample quarantine** (docs/robustness.md): a decode failure (truncated
+  JPEG, bit-rot, file mid-copy) used to propagate out of the Loader's
+  producer thread and abort the whole epoch. Now ``load`` retries with a
+  short backoff (the transient-read case), then substitutes a
+  deterministic same-class replacement sample and counts the event
+  (``quarantine_count`` / ``quarantined``) — one corrupt file out of a
+  million degrades the epoch by one sample instead of killing the run.
+  ``DataConfig.quarantine=False`` restores fail-fast propagation.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,6 +39,51 @@ from PIL import Image
 
 from tpuic.config import DataConfig
 from tpuic.data import transforms as T
+from tpuic.runtime import faults as _faults
+
+# Everything PIL raises for unreadable/corrupt image bytes:
+# UnidentifiedImageError and "image file is truncated" are OSError
+# subclasses; zlib/decoder failures surface as ValueError; ancient PIL
+# raised SyntaxError for broken PNG chunks.
+_DECODE_ERRORS = (OSError, ValueError, SyntaxError)
+
+
+def quarantined_decode(dataset, index: int, decode):
+    """THE quarantine policy, shared by the per-sample path (``load``) and
+    the pack build (pack.py): try ``decode(index)``; on a decode error
+    retry ``cfg.quarantine_retries`` times with ``cfg.quarantine_backoff_s``
+    between attempts (a file mid-copy becomes readable), then — with
+    ``cfg.quarantine`` on — record the event and walk up to 8 same-class
+    replacement candidates (corruption is correlated: interrupted copies
+    land on neighbors, so the first candidate may be corrupt too).
+
+    Returns ``(value, actual_index)`` — the caller takes the REPLACEMENT's
+    label/id when ``actual_index != index``. Re-raises the original error
+    when quarantine is off or every candidate fails. Only
+    ``_DECODE_ERRORS`` engage the policy: programming errors (bad shapes,
+    type bugs) propagate immediately instead of masquerading as mass
+    corruption."""
+    cfg = dataset.cfg
+    try:
+        return decode(index), index
+    except _DECODE_ERRORS:
+        for _ in range(max(0, int(cfg.quarantine_retries))):
+            time.sleep(max(0.0, float(cfg.quarantine_backoff_s)))
+            try:
+                return decode(index), index
+            except _DECODE_ERRORS:
+                continue
+        if not cfg.quarantine:
+            raise
+        dataset._record_quarantine(dataset.samples[index][0])
+        j = index
+        for _ in range(8):
+            j = dataset.quarantine_replacement(j)
+            try:
+                return decode(j), j
+            except _DECODE_ERRORS:
+                continue
+        raise  # every candidate corrupt: surface the original error
 
 _IMAGE_EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".webp"}
 
@@ -83,6 +138,13 @@ class ImageFolderDataset:
         if not samples:
             raise ValueError(f"no images under {root}")
         self.samples = samples
+        # Quarantine bookkeeping: total replacement events and per-path
+        # counts (a path appearing here means its bytes failed to decode
+        # after retries and a substitute was served). Lock because loads
+        # run on the Loader's worker threads.
+        self.quarantine_count = 0
+        self.quarantined: Dict[str, int] = {}
+        self._quarantine_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -102,6 +164,30 @@ class ImageFolderDataset:
         return np.bincount(labels[labels >= 0],
                            minlength=self.num_classes).astype(np.int64)
 
+    def _decode(self, path: str) -> np.ndarray:
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB") if im.mode not in ("RGB",)
+                              else im)
+
+    def quarantine_replacement(self, index: int) -> int:
+        """Deterministic substitute for a sample whose file won't decode:
+        the next index (cyclic) carrying the SAME label — the label stays
+        honest and the batch stays in-distribution — falling back to the
+        plain next index for a single-sample class (its real label rides
+        along, so training never sees a mislabeled row)."""
+        label = self.samples[index][1]
+        n = len(self.samples)
+        for off in range(1, n):
+            j = (index + off) % n
+            if self.samples[j][1] == label:
+                return j
+        return (index + 1) % n
+
+    def _record_quarantine(self, path: str) -> None:
+        with self._quarantine_lock:
+            self.quarantine_count += 1
+            self.quarantined[path] = self.quarantined.get(path, 0) + 1
+
     def load(self, index: int, rng: Optional[np.random.Generator] = None
              ) -> Tuple[np.ndarray, int, str]:
         """Decode → RGB → resize → [augment] → normalize. Returns
@@ -111,13 +197,28 @@ class ImageFolderDataset:
         Augment decisions are drawn ONCE (transforms.draw_augment, the single
         source of the RNG stream) and then executed either by the fused
         native pass (tpuic/native, when built and cfg.native) or by the NumPy
-        transforms — identical output per (seed, epoch, index) either way."""
+        transforms — identical output per (seed, epoch, index) either way.
+
+        An undecodable file goes through ``quarantined_decode``: retry with
+        backoff, then serve a deterministic same-class replacement — its
+        image, ITS label, its id — and count the event. The augment RNG
+        stream is the caller's (seed, epoch, index) generator either way,
+        so the substitution is bitwise deterministic too."""
+        def _decode_index(i: int) -> np.ndarray:
+            # Deterministic injection point ('decode_error' keyed by
+            # dataset index) — a corrupt file without a corrupt file.
+            # Checked per ATTEMPT: armed without a times cap it models
+            # persistent corruption (retries fail too -> quarantine);
+            # armed with times=1 it models a transient read (the retry
+            # recovers).
+            if _faults.fire("decode_error", step=i):
+                raise OSError(f"injected decode error for index {i}")
+            return self._decode(self.samples[i][0])
+
+        img, index = quarantined_decode(self, index, _decode_index)
         path, label = self.samples[index]
-        with Image.open(path) as im:
-            img = np.asarray(im.convert("RGB") if im.mode not in ("RGB",)
-                             else im)
-        img = T.to_rgb(img)
         c = self.cfg
+        img = T.to_rgb(img)
         if self.train and rng is not None:
             k, vflip, hflip, color, factor = T.draw_augment(
                 rng, p_vflip=c.p_vflip, p_hflip=c.p_hflip,

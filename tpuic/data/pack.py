@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from tpuic.data.folder import ImageFolderDataset
+from tpuic.data.folder import ImageFolderDataset, quarantined_decode
 from tpuic.data import transforms as T
 
 _PACK_VERSION = 1
@@ -106,12 +106,37 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
     mm = np.memmap(tmp, np.uint8, "w+", shape=(n, row))
     import time
     t0 = time.perf_counter()
-    for i, (path, _) in enumerate(dataset.samples):
-        mm[i] = _decode_one(path, size).reshape(-1)
+    # Pack-time sample quarantine (docs/robustness.md): the cache is built
+    # ONCE over the whole fold, so one truncated file used to abort the
+    # entire pack (and with it the Trainer). Same policy as the per-sample
+    # path (folder.py load): retry with backoff, then store a deterministic
+    # same-class replacement row — WITH the replacement's label, so the
+    # packed labels stay honest — and count the event.
+    labels = [int(l) for _, l in dataset.samples]
+    image_ids = [dataset.image_id(i) for i in range(n)]
+    quarantined = 0
+    for i in range(n):
+        # Shared quarantine policy (folder.quarantined_decode): retry with
+        # backoff, then cascade through same-class replacements. The packed
+        # row takes the replacement's pixels, LABEL, and IMAGE ID —
+        # identical semantics to the unpacked path, so per-sample records
+        # keyed by id agree between packed and decode runs.
+        img, j = quarantined_decode(
+            dataset, i, lambda idx: _decode_one(dataset.samples[idx][0],
+                                                size))
+        if j != i:
+            labels[i] = int(dataset.samples[j][1])
+            image_ids[i] = dataset.image_id(j)
+            quarantined += 1
+        mm[i] = img.reshape(-1)
         if verbose and i and i % 2000 == 0:
             rate = i / (time.perf_counter() - t0)
             print(f"[pack] {dataset.fold}: {i}/{n} ({rate:.0f} img/s)",
                   flush=True)
+    if verbose and quarantined:
+        print(f"[pack] {dataset.fold}: quarantined {quarantined} "
+              f"undecodable file(s); packed same-class replacements",
+              flush=True)
     mm.flush()
     del mm
     os.replace(tmp, bin_path)
@@ -120,8 +145,8 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
         "fold": dataset.fold,
         "size": size,
         "n": n,
-        "labels": [int(l) for _, l in dataset.samples],
-        "image_ids": [dataset.image_id(i) for i in range(n)],
+        "labels": labels,
+        "image_ids": image_ids,
         "class_to_idx": dataset.class_to_idx,
         "fingerprint": fp,
     }
@@ -133,7 +158,10 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
         print(f"[pack] {dataset.fold}: packed {n} images @ {size}px in "
               f"{dt:.1f}s ({n / max(dt, 1e-9):.0f} img/s) -> {bin_path}",
               flush=True)
-    return PackedDataset(bin_path, meta, train=dataset.train, cfg=dataset.cfg)
+    packed = PackedDataset(bin_path, meta, train=dataset.train,
+                           cfg=dataset.cfg)
+    packed.quarantine_count = quarantined
+    return packed
 
 
 class PackedDataset:
@@ -164,6 +192,11 @@ class PackedDataset:
                             or int(self._labels.min()) >= 0)
         n, s = int(meta["n"]), self.resize_size
         self._mm = np.memmap(bin_path, np.uint8, "r", shape=(n, s, s, 3))
+        # Pack-time quarantine events (pack_dataset sets the real count on
+        # a fresh build; a cache hit reports 0 — the cache's rows were all
+        # decodable when written). Epoch-log surfacing reads this.
+        self.quarantine_count = 0
+        self.quarantined: Dict[str, int] = {}
 
     def __len__(self) -> int:
         return self._mm.shape[0]

@@ -226,6 +226,14 @@ class Loader:
             return n // self.global_batch
         return -(-n // self.global_batch)
 
+    @property
+    def quarantine_count(self) -> int:
+        """Total samples the dataset served a quarantine replacement for
+        (docs/robustness.md): decode failures the producer absorbed instead
+        of aborting the epoch. Monotonic across epochs; the Trainer logs
+        the per-epoch delta."""
+        return int(getattr(self.dataset, "quarantine_count", 0) or 0)
+
     def steps_per_epoch(self) -> int:
         return len(self)
 

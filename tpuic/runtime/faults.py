@@ -1,0 +1,194 @@
+"""Deterministic fault injection — the harness that proves the
+fault-tolerance layer works (SURVEY.md §5: the reference has no failure
+story at all, so none of its failure paths are *testable* either).
+
+Every recovery path in this repo (non-finite step guard + rollback,
+checkpoint integrity ladder, sample quarantine, preemption drain) has an
+injection point registered here, so tests drive each failure
+deterministically on CPU instead of waiting for a real corrupt JPEG or a
+real scheduler kill. Injection is **off by default and free**: an unarmed
+``fire()`` is one dict lookup.
+
+Registered points (site → meaning of ``step``):
+
+- ``nan_batch``     — train loop (train/loop.py): poison this step's batch
+                      images with NaN before the jitted step. ``step`` is
+                      the host-tracked global optimizer step.
+- ``sigterm``       — train loop: deliver SIGTERM to this process at the
+                      given global step (drives the PreemptionGuard flush).
+- ``decode_error``  — ImageFolderDataset.load (data/folder.py): raise an
+                      OSError in place of the decode. ``step`` is the
+                      dataset index.
+- ``ckpt_kill``     — CheckpointManager commit (checkpoint/manager.py):
+                      raise InjectedFault after the staged save is written
+                      but BEFORE it is rotated into its track — the
+                      SIGKILL-mid-write simulation (the committed track
+                      must survive untouched).
+- ``hang_device``   — InferenceEngine._dispatch (serve/engine.py): sleep
+                      ``param`` seconds before the device call — a stuck
+                      device call for drain-timeout tests.
+
+Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
+the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
+``point[@STEP|@LO-HI][*TIMES]`` directives, e.g.::
+
+    TPUIC_FAULTS='nan_batch@100-105,sigterm@200' python train.py ...
+
+File-corruption helpers (``truncate_file``, ``corrupt_file``) live here
+too: they are the test-side tools for the *at-rest* faults (truncated
+image, corrupt checkpoint file) that have no code injection point.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, Optional, Union
+
+__all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
+           "fire", "param", "fired", "truncate_file", "corrupt_file"]
+
+
+class InjectedFault(RuntimeError):
+    """Raised by injection points that simulate a hard kill mid-operation
+    (distinct type so tests can assert it was THIS fault, and production
+    except-clauses never swallow it by accident)."""
+
+
+class _Arm:
+    __slots__ = ("steps", "times", "param", "count")
+
+    def __init__(self, steps, times, param):
+        self.steps = steps      # None = any step; else a set of ints
+        self.times = times      # None = unlimited; else max firings
+        self.param = param      # free-form payload (e.g. hang seconds)
+        self.count = 0          # firings so far
+
+
+class FaultPlan:
+    """A set of armed injection points. Thread-safe: fire() is called from
+    producer threads, the serve batcher, and the train loop alike."""
+
+    def __init__(self, spec: str = "") -> None:
+        self._lock = threading.Lock()
+        self._arms: Dict[str, _Arm] = {}
+        self.fired: Dict[str, int] = {}
+        if spec:
+            self._parse(spec)
+
+    def _parse(self, spec: str) -> None:
+        for directive in spec.split(","):
+            directive = directive.strip()
+            if not directive:
+                continue
+            times = None
+            if "*" in directive:
+                directive, t = directive.rsplit("*", 1)
+                times = int(t)
+            steps: Optional[Iterable[int]] = None
+            if "@" in directive:
+                directive, s = directive.split("@", 1)
+                if "-" in s:
+                    lo, hi = s.split("-", 1)
+                    steps = range(int(lo), int(hi) + 1)
+                else:
+                    steps = (int(s),)
+            self.arm(directive, steps=steps, times=times)
+
+    def arm(self, point: str, *, steps: Union[int, Iterable[int], None] = None,
+            times: Optional[int] = None, param=None) -> None:
+        """Arm ``point``: fire at the given ``steps`` (int, iterable, or
+        None = every call), at most ``times`` total firings."""
+        if isinstance(steps, int):
+            steps = (steps,)
+        with self._lock:
+            self._arms[point] = _Arm(
+                None if steps is None else frozenset(int(s) for s in steps),
+                times, param)
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._arms.clear()
+            else:
+                self._arms.pop(point, None)
+
+    def reset(self) -> None:
+        """Disarm everything and clear firing history (test isolation)."""
+        with self._lock:
+            self._arms.clear()
+            self.fired.clear()
+
+    def param(self, point: str):
+        """The armed payload of ``point`` (None when unarmed or no payload)."""
+        with self._lock:
+            a = self._arms.get(point)
+            return a.param if a is not None else None
+
+    def fire(self, point: str, step: Optional[int] = None) -> bool:
+        """True iff ``point`` is armed for this call — and records the
+        firing. The injection SITE decides what a firing means."""
+        with self._lock:
+            a = self._arms.get(point)
+            if a is None:
+                return False
+            if a.steps is not None and (step is None
+                                        or int(step) not in a.steps):
+                return False
+            if a.times is not None and a.count >= a.times:
+                return False
+            a.count += 1
+            self.fired[point] = self.fired.get(point, 0) + 1
+            return True
+
+
+# The process-global plan: sites call the module-level functions, tests and
+# the TPUIC_FAULTS env var arm it.
+plan = FaultPlan(os.environ.get("TPUIC_FAULTS", ""))
+
+
+def arm(point: str, *, steps=None, times=None, param=None) -> None:
+    plan.arm(point, steps=steps, times=times, param=param)
+
+
+def disarm(point: Optional[str] = None) -> None:
+    plan.disarm(point)
+
+
+def reset() -> None:
+    plan.reset()
+
+
+def fire(point: str, step: Optional[int] = None) -> bool:
+    return plan.fire(point, step)
+
+
+def param(point: str):
+    return plan.param(point)
+
+
+def fired(point: str) -> int:
+    return plan.fired.get(point, 0)
+
+
+# -- at-rest corruption helpers (test-side tools) --------------------------
+def truncate_file(path: str, keep: int = 8) -> None:
+    """Truncate ``path`` to its first ``keep`` bytes — the classic
+    interrupted-copy / interrupted-write artifact (truncated JPEG, half a
+    checkpoint shard)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def corrupt_file(path: str, offset: int = 0, nbytes: int = 16) -> None:
+    """Flip ``nbytes`` bytes of ``path`` starting at ``offset`` (XOR 0xFF)
+    — silent bit-rot that keeps the file size, so only content checksums
+    (the checkpoint manifest) can catch it."""
+    size = os.path.getsize(path)
+    offset = min(offset, max(0, size - 1))
+    n = min(nbytes, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        data = f.read(n)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in data))

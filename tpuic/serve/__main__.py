@@ -19,6 +19,14 @@ driver only *submits* work and drains completed futures opportunistically
 
 A final stats line (queue wait, pad efficiency, bucket histogram,
 latency percentiles, compile counts) goes to stderr on shutdown.
+
+Graceful shutdown (docs/robustness.md): SIGTERM/SIGINT latch a
+PreemptionGuard (the trainer's mechanism, runtime/preemption.py) instead
+of killing the process mid-batch — the driver stops accepting requests,
+drains everything in flight for up to ``--drain-timeout`` seconds
+(stragglers get a per-request error line, never a silent drop), closes
+the engine, and exits 0. A scheduler eviction loses zero accepted
+requests that the device can finish inside the grace window.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ import os
 import sys
 import time
 from collections import deque
+from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as np
 
@@ -167,7 +176,19 @@ def main(argv=None) -> int:
     p.add_argument("--once", action="store_true",
                    help="with --watch: process current files, then exit")
     p.add_argument("--out", default="", help="output JSONL (default stdout)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="on SIGTERM/SIGINT, wait up to this many seconds "
+                        "for in-flight requests before failing stragglers "
+                        "with an error line and exiting")
     args = p.parse_args(argv)
+
+    # Install the latch BEFORE the (potentially minutes-long) checkpoint
+    # load + AOT warmup: an eviction during startup must also exit
+    # cleanly, not dump a traceback from inside a compile.
+    import signal
+
+    from tpuic.runtime.preemption import PreemptionGuard
+    guard = PreemptionGuard(signals=(signal.SIGTERM,)).install()
 
     if args.classes and not os.path.isfile(args.classes):
         # Validate BEFORE the checkpoint load + per-bucket AOT warmup —
@@ -181,23 +202,78 @@ def main(argv=None) -> int:
     pending = deque()  # (id, Future) in submission order
     served = 0
 
-    def drain(block: bool) -> None:
+    def emit(rid, probs, order) -> None:
         nonlocal served
+        topk = [[names.get(int(order[0, j]), str(int(order[0, j]))),
+                 round(float(probs[0, order[0, j]]), 6)]
+                for j in range(k)]
+        out.write(json.dumps({"id": rid, "pred": topk[0][0],
+                              "prob": topk[0][1], "topk": topk}) + "\n")
+        out.flush()
+        served += 1
+
+    def drain(block: bool, deadline: float = None) -> None:
+        """Emit completed responses; ``block`` waits for stragglers, up to
+        ``deadline`` (time.monotonic()). Past the deadline, requests the
+        device DID finish still emit their results (in submission order);
+        only genuinely unresolved ones get an explicit error line — never
+        a silent drop, never a discarded finished result.
+
+        The no-deadline blocking wait polls in short slices re-checking
+        the SIGTERM latch: a plain ``fut.result()`` is resumed after
+        signals (PEP 475), so a SIGTERM arriving while draining a wedged
+        request at EOF would otherwise never be observed — the latch
+        escalates the wait to a ``--drain-timeout`` deadline instead."""
         while pending and (block or pending[0][1].done()):
             rid, fut = pending.popleft()
             try:
-                probs, order = fut.result()
+                if block and deadline is None:
+                    while not fut.done() and not guard.triggered:
+                        try:
+                            fut.result(timeout=0.5)
+                        except (TimeoutError, _FutTimeout):
+                            pass
+                    if not fut.done() and guard.triggered:
+                        # Escalate: persists for the remaining stragglers
+                        # (``deadline`` is function-local).
+                        deadline = (time.monotonic()
+                                    + max(0.0, args.drain_timeout))
+                if deadline is None:
+                    probs, order = fut.result()
+                else:
+                    probs, order = fut.result(
+                        timeout=max(0.0, deadline - time.monotonic()))
+            except (TimeoutError, _FutTimeout):
+                pending.appendleft((rid, fut))
+                expired = list(pending)
+                pending.clear()
+                for srid, sfut in expired:
+                    if sfut.done() and not sfut.cancelled():
+                        try:
+                            p, o = sfut.result()
+                        except Exception as e:  # noqa: BLE001
+                            out.write(json.dumps(
+                                {"id": srid, "error": str(e)}) + "\n")
+                        else:
+                            emit(srid, p, o)
+                        continue
+                    sfut.cancel()  # not-yet-dispatched may still cancel
+                    out.write(json.dumps({
+                        "id": srid, "error": "drain timeout: engine "
+                        "shutting down before this request finished"}) + "\n")
+                out.flush()
+                return
             except Exception as e:  # noqa: BLE001 — per-request error line
                 out.write(json.dumps({"id": rid, "error": str(e)}) + "\n")
                 out.flush()
                 continue
-            topk = [[names.get(int(order[0, j]), str(int(order[0, j]))),
-                     round(float(probs[0, order[0, j]]), 6)]
-                    for j in range(k)]
-            out.write(json.dumps({"id": rid, "pred": topk[0][0],
-                                  "prob": topk[0][1], "topk": topk}) + "\n")
-            out.flush()
-            served += 1
+            except BaseException:
+                # KeyboardInterrupt/SystemExit mid-wait: this request is
+                # already popped — put it back so the handler's follow-up
+                # drain still owns it (never a silent drop).
+                pending.appendleft((rid, fut))
+                raise
+            emit(rid, probs, order)
 
     def submit(rid: str, path: str) -> bool:
         """Decode + enqueue; False = decode failed (error line emitted)."""
@@ -216,11 +292,13 @@ def main(argv=None) -> int:
             exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
             seen: set = set()
             attempts: dict = {}
-            while True:
+            while not guard.triggered:
                 fresh = sorted(
                     f for f in os.listdir(args.watch)
                     if f.lower().endswith(exts) and f not in seen)
                 for f in fresh:
+                    if guard.triggered:
+                        break  # stop ACCEPTING; in-flight drains below
                     if submit(f, os.path.join(args.watch, f)):
                         seen.add(f)
                         attempts.pop(f, None)
@@ -240,10 +318,10 @@ def main(argv=None) -> int:
                     break
                 time.sleep(args.poll_s)
         else:
-            for line in sys.stdin:
+            def handle(line: str) -> None:
                 line = line.strip()
                 if not line:
-                    continue
+                    return
                 try:
                     req = json.loads(line)
                     path = req["path"]
@@ -251,13 +329,64 @@ def main(argv=None) -> int:
                     out.write(json.dumps(
                         {"error": f"bad request line: {line[:80]}"}) + "\n")
                     out.flush()
-                    continue
+                    return
                 submit(str(req.get("id", path)), path)
-        drain(block=True)
+
+            # select()-gated RAW reads, not ``for line in sys.stdin``: a
+            # signal handler only sets the latch and PEP 475 would resume
+            # a blocked readline — an idle server would never observe
+            # SIGTERM. With a select timeout the loop re-checks the latch
+            # (and opportunistically drains) at least every 200 ms. Raw
+            # os.read + explicit line splitting, because Python's stdin
+            # buffering would hide burst-written lines from select (the
+            # bytes sit in the TextIOWrapper, not at the fd) and stall
+            # every request after the first. A non-fd stdin (tests feeding
+            # a StringIO) can't select; it reads unguarded, the
+            # pre-rewrite behavior.
+            import select
+            try:
+                stdin_fd = sys.stdin.fileno()
+            except (ValueError, OSError, AttributeError):
+                stdin_fd = None
+            if stdin_fd is None:
+                for line in sys.stdin:
+                    if guard.triggered:
+                        break
+                    handle(line)
+            else:
+                tail = b""
+                while not guard.triggered:
+                    try:
+                        ready, _, _ = select.select([stdin_fd], [], [], 0.2)
+                    except (OSError, ValueError):  # stdin closed under us
+                        break
+                    if not ready:
+                        drain(block=False)
+                        continue
+                    chunk = os.read(stdin_fd, 1 << 16)  # ready: won't block
+                    if not chunk:
+                        break  # EOF
+                    *lines, tail = (tail + chunk).split(b"\n")
+                    for raw in lines:
+                        handle(raw.decode("utf-8", "replace"))
+                if tail.strip() and not guard.triggered:
+                    handle(tail.decode("utf-8", "replace"))  # unterminated last line
+        if guard.triggered:
+            # Graceful preemption: everything already accepted drains for
+            # up to --drain-timeout; stragglers get explicit error lines.
+            print(f"[serve] SIGTERM: draining {len(pending)} in-flight "
+                  f"request(s) (timeout {args.drain_timeout:.1f}s)",
+                  file=sys.stderr)
+            drain(block=True,
+                  deadline=time.monotonic() + max(0.0, args.drain_timeout))
+        else:
+            drain(block=True)
     except KeyboardInterrupt:
-        drain(block=True)
+        drain(block=True,
+              deadline=time.monotonic() + max(0.0, args.drain_timeout))
     finally:
-        engine.close()
+        guard.uninstall()
+        engine.close(timeout=max(5.0, args.drain_timeout))
         print(f"[serve] served {served} requests; stats: "
               f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)
         if out is not sys.stdout:

@@ -50,6 +50,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from tpuic.runtime import faults as _faults
 from tpuic.serve.metrics import ServeStats
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
@@ -343,9 +344,16 @@ class InferenceEngine:
 
     def _dispatch(self, reqs):
         """Pad to bucket, H2D, call the cached executable.  Returns the
-        in-flight batch; results are NOT read back here — JAX dispatch is
-        async, so the device crunches this batch while the batcher
-        assembles the next one (double buffering)."""
+        in-flight batch (None when every request failed staging); results
+        are NOT read back here — JAX dispatch is async, so the device
+        crunches this batch while the batcher assembles the next one
+        (double buffering).
+
+        Error isolation: a request whose array fails the staging copy
+        (caller handed something np can't materialize) gets the exception
+        on ITS future and is dropped from the batch — siblings coalesced
+        into the same device batch still dispatch and resolve. One bad
+        request must never strand its batchmates (docs/robustness.md)."""
         rows = sum(r.n for r in reqs)
         bucket = self.bucket_for(rows)
         if len(reqs) == 1 and reqs[0].n == bucket:
@@ -357,11 +365,32 @@ class InferenceEngine:
             batch = np.zeros((bucket, self.image_size, self.image_size,
                               self.channels), self.input_dtype)
             off = 0
+            ok = []
             for r in reqs:
-                # np coerces a jax.Array operand here (one D2H for the
-                # request's rows — only on the padded/coalesced path).
-                batch[off:off + r.n] = r.images
+                try:
+                    # np coerces a jax.Array operand here (one D2H for the
+                    # request's rows — only on the padded/coalesced path).
+                    batch[off:off + r.n] = r.images
+                except BaseException as e:
+                    if not r.future.cancelled():
+                        r.future.set_exception(e)
+                    continue
+                ok.append(r)
                 off += r.n
+            if not ok:
+                return None
+            if off < rows:
+                # Some request dropped: the survivors may fit a smaller
+                # bucket (rows packed contiguously from 0, so a prefix
+                # view is the valid batch).
+                reqs = ok
+                bucket = self.bucket_for(off)
+                batch = batch[:bucket]
+                rows = off
+        if _faults.fire("hang_device"):
+            # 'hang_device' injection (runtime/faults.py): a stuck device
+            # call, for close()/drain-timeout tests.
+            time.sleep(float(_faults.param("hang_device") or 1.0))
         now = time.monotonic()
         self.stats.record_dispatch(bucket, rows,
                                    [now - r.t_enqueue for r in reqs])
@@ -391,10 +420,21 @@ class InferenceEngine:
         off = 0
         for r in reqs:
             lo, hi = off, off + r.n
-            if not r.future.cancelled():
+            off = hi
+            if r.future.cancelled():
+                continue
+            # Per-request isolation: an exception while slicing/setting ONE
+            # request's result (exotic result pytree, an already-resolved
+            # future) lands on that future alone — sibling requests in the
+            # same device batch still resolve and the batcher stays alive.
+            try:
                 r.future.set_result(
                     self._jax.tree.map(lambda a: a[lo:hi], host))
-            off = hi
+            except BaseException as e:
+                try:
+                    r.future.set_exception(e)
+                except BaseException:
+                    pass  # future already done — nothing left to deliver
 
     def _run(self) -> None:
         inflight = None

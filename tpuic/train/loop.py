@@ -24,12 +24,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
 import time
 from typing import Optional
 
 import jax
 import numpy as np
 from tqdm import tqdm
+
+from tpuic.runtime import faults as _faults
 
 from tpuic.checkpoint.manager import CheckpointManager
 from tpuic.config import Config
@@ -132,6 +135,7 @@ class Trainer:
                         + ", ".join(f"{c}={x:.3f}" for c, x in
                                     zip(self.train_ds.classes,
                                         cfg.optim.class_weights)))
+        self.mcfg = mcfg  # resolved model config (inferred num_classes)
         self.model = create_model_from_config(mcfg, mesh=self.mesh)
         steps = max(1, self.train_loader.steps_per_epoch())
         self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs)
@@ -200,32 +204,19 @@ class Trainer:
             # resumes at the last periodic save instead of replaying epochs.
             self.state, self.start_epoch, self.best_score = \
                 self.ckpt.restore_into(self.state)
-            self.start_step = (self.ckpt.last_restore_step_in_epoch or 0)
-            if self.start_step:
-                saved = self.ckpt.last_restore_geometry
-                live = self._loader_geometry()
-                if saved is not None and any(
-                        a not in (-1, b) for a, b in zip(saved, live)):
-                    # The epoch permutation is keyed by (seed, n_samples)
-                    # and sliced by global_batch — a mismatch in any means
-                    # the offset points at different samples.
-                    host0_print(
-                        f"[ckpt] mid-epoch checkpoint was flushed under "
-                        f"loader geometry (global_batch, seed, n_samples)="
-                        f"{saved} but this run has {live} — the step "
-                        f"offset would skip the wrong samples; replaying "
-                        f"epoch {self.start_epoch} from its start instead")
-                    self.start_step = 0
-                elif self.start_step > len(self.train_loader):
-                    host0_print(
-                        f"[ckpt] mid-epoch step {self.start_step} exceeds "
-                        f"this run's {len(self.train_loader)} steps/epoch "
-                        f"(dataset changed?) — replaying epoch "
-                        f"{self.start_epoch} from its start instead")
-                    self.start_step = 0
+            self.start_step = self._validated_start_step()
             if self.state_sharding is not None:
                 from tpuic.parallel.sharding import shard_state
                 self.state = shard_state(self.state, self.state_sharding)
+        # Non-finite rollback bookkeeping (docs/robustness.md): the jitted
+        # step skips poisoned updates in-graph (train/step.py guard) and
+        # counts the consecutive-skip streak in state.skip_count; the
+        # deferred log drain watches the streak and, past
+        # run.skip_threshold, flags a rollback — fit() then restores the
+        # last good checkpoint through the integrity ladder and continues.
+        self._rollback_pending = False
+        self.rollbacks = 0
+        self._quarantine_seen = 0
 
     def _init_from_torch(self, path: str) -> None:
         """Pretrained-weight initialization from a torch checkpoint.
@@ -249,6 +240,38 @@ class Trainer:
         step offset."""
         ld = self.train_loader
         return (ld.global_batch, ld.seed, len(ld.dataset))
+
+    def _validated_start_step(self) -> int:
+        """Step offset of the checkpoint the manager just restored, IF its
+        recorded loader geometry matches this run (shared by __init__
+        resume and the non-finite rollback — both must refuse an offset
+        that would skip the wrong samples)."""
+        start_step = self.ckpt.last_restore_step_in_epoch or 0
+        if not start_step:
+            return 0
+        saved = self.ckpt.last_restore_geometry
+        live = self._loader_geometry()
+        epoch = (self.ckpt.last_restore_meta or (0, 0))[0]
+        if saved is not None and any(
+                a not in (-1, b) for a, b in zip(saved, live)):
+            # The epoch permutation is keyed by (seed, n_samples)
+            # and sliced by global_batch — a mismatch in any means
+            # the offset points at different samples.
+            host0_print(
+                f"[ckpt] mid-epoch checkpoint was flushed under "
+                f"loader geometry (global_batch, seed, n_samples)="
+                f"{saved} but this run has {live} — the step "
+                f"offset would skip the wrong samples; replaying "
+                f"epoch {epoch} from its start instead")
+            return 0
+        if start_step > len(self.train_loader):
+            host0_print(
+                f"[ckpt] mid-epoch step {start_step} exceeds "
+                f"this run's {len(self.train_loader)} steps/epoch "
+                f"(dataset changed?) — replaying epoch "
+                f"{epoch} from its start instead")
+            return 0
+        return start_step
 
     # -- epochs -------------------------------------------------------------
     def train_epoch(self, epoch: int, start_step: int = 0) -> float:
@@ -291,6 +314,11 @@ class Trainer:
         # handle_preemption off, no polling (and no allgather) happens.
         preempt_sync = 16
         for step, batch in enumerate(bar):
+            # Fault-injection sites (runtime/faults.py; inert when unarmed):
+            # 'sigterm' drives the REAL preemption path — the latch, the
+            # boundary agreement, the mid-epoch flush — deterministically.
+            if preempt_on and _faults.fire("sigterm", step=step0 + step):
+                os.kill(os.getpid(), signal.SIGTERM)
             trig = preempt_on and self.preemption.triggered
             if preempt_on and multi:
                 if step % preempt_sync == 0:
@@ -302,14 +330,23 @@ class Trainer:
             if trig:
                 bar.close()
                 break
-            self.state, metrics = self.train_step(
-                self.state, {k: batch[k] for k in ("image", "label", "mask")})
+            fbatch = {k: batch[k] for k in ("image", "label", "mask")}
+            if _faults.fire("nan_batch", step=step0 + step):
+                # Poison this step's images host-side: same shapes/dtypes,
+                # so the guard's zero-recompile contract is what's tested.
+                fbatch["image"] = fbatch["image"] * np.float32("nan")
+            self.state, metrics = self.train_step(self.state, fbatch)
             self.last_epoch_steps = start_step + step + 1
             if (step + 1) % log_every == 0:
                 handles = {"loss": metrics["loss"],
                            "accuracy": metrics["accuracy"]}
                 if "lr" in metrics:
                     handles["lr"] = metrics["lr"]
+                if "skip_count" in metrics:
+                    # The in-graph consecutive-skip streak rides the SAME
+                    # deferred drain as the other metrics — rollback
+                    # detection costs zero extra host syncs.
+                    handles["skip_count"] = metrics["skip_count"]
                 _async_copy(handles)
                 now = time.perf_counter()
                 imgs_per_sec = log_every * global_batch / max(now - t_log,
@@ -326,6 +363,12 @@ class Trainer:
                     # steady-state path.
                     self._drain_train_log(pending, losses, bar, epoch)
                     pending = None
+                if self._rollback_pending:
+                    # Grinding out the rest of the epoch on (guarded but
+                    # unprogressing) steps is pointless — hand back to
+                    # fit() for the restore now.
+                    bar.close()
+                    break
         if pending is not None:
             self._drain_train_log(pending, losses, bar, epoch)
         # Epoch-mean loss over all steps, one sync, off the hot path: the
@@ -333,22 +376,51 @@ class Trainer:
         # to the reference bar, train.py:67-68).
         if metrics is not None and losses.count == 0:
             losses.update(float(metrics["loss"]), 1)
+        # Quarantine surfacing (docs/robustness.md): decode failures the
+        # data layer absorbed this epoch, one console line + JSONL record
+        # per epoch with events — a corrupt file is visible without being
+        # fatal.
+        q = self.train_loader.quarantine_count
+        if q > self._quarantine_seen:
+            delta = q - self._quarantine_seen
+            self._quarantine_seen = q
+            host0_print(f"[quarantine] epoch {epoch}: {delta} sample "
+                        f"load(s) served a replacement (total {q})")
+            self.logger.write(step0 + self.last_epoch_steps - start_step,
+                              quarantined=delta, quarantined_total=q)
         return losses.avg
 
     def _drain_train_log(self, pending, losses: AverageMeter, bar,
                          epoch: int) -> None:
         """Read one deferred log interval (a single batched device_get) and
-        emit the bar description + JSONL record for it."""
+        emit the bar description + JSONL record for it. Also the rollback
+        watchdog: the drained skip_count is the in-graph consecutive
+        non-finite streak; past run.skip_threshold it flags a rollback
+        (detection latency <= ~2 log intervals — the price of keeping the
+        hot path free of per-step host syncs)."""
         step_num, imgs_per_sec, handles = pending
         vals = jax.device_get(handles)
         loss = float(vals["loss"])
         losses.update(loss, 1)
         bar.set_description(
             f"Epoch: {epoch}; Loss {losses.val:.4f}|({losses.avg:.4f})")
+        extra = {}
+        streak = int(vals.get("skip_count", 0))
+        if streak:
+            extra["skipped_streak"] = streak
         self.logger.write(step_num, loss=loss,
                           accuracy=float(vals["accuracy"]),
                           lr=float(vals.get("lr", 0.0)),
-                          images_per_sec=round(imgs_per_sec, 1))
+                          images_per_sec=round(imgs_per_sec, 1), **extra)
+        thr = self.cfg.run.skip_threshold
+        if (thr > 0 and streak >= thr and self.cfg.run.rollback
+                and not self._rollback_pending):
+            host0_print(
+                f"[rollback] {streak} consecutive non-finite steps "
+                f"(threshold {thr}) at step {step_num} — state is still "
+                f"finite (guard skipped the updates); restoring the last "
+                f"good checkpoint instead of grinding forward")
+            self._rollback_pending = True
 
     def val_epoch(self, epoch: int) -> float:
         """Reference val_epoch (train.py:78-97): exact global accuracy ×100,
@@ -446,6 +518,75 @@ class Trainer:
         return score
 
     # -- driver -------------------------------------------------------------
+    def _do_rollback(self) -> int:
+        """Restore the last good checkpoint after a non-finite streak
+        (docs/robustness.md); returns the epoch to continue from.
+
+        The restore goes through the integrity ladder, the skip streak is
+        reset, and with run.rollback_rewarm_steps the LR re-enters its
+        schedule on a linear ramp (a new optimizer transform — one retrace
+        of the train step, the only recompile on any rollback path)."""
+        self._rollback_pending = False
+        self.rollbacks += 1
+        run = self.cfg.run
+        if self.rollbacks > run.max_rollbacks:
+            raise RuntimeError(
+                f"non-finite rollback #{self.rollbacks} exceeds "
+                f"run.max_rollbacks={run.max_rollbacks}: the run keeps "
+                "diverging after restore — fix the data/LR instead of "
+                "looping restore->diverge forever")
+        # Commit any staged save FIRST: the most recent epoch's checkpoint
+        # normally still sits in '{track}.new' (its commit rides the next
+        # wait()), and probing newest_track() before committing would
+        # spuriously report "nothing to roll back to".
+        self.ckpt.wait()
+        if self.ckpt.newest_track() is None:
+            raise RuntimeError(
+                f"{run.skip_threshold} consecutive non-finite steps before "
+                "any checkpoint existed — nothing to roll back to (the "
+                "guard kept the state finite; lower the LR or check the "
+                "data)")
+        import jax.numpy as jnp
+        self.state, epoch, restored_best = self.ckpt.restore_into(self.state)
+        # 'best' on disk still holds its score; never let a rollback
+        # resurrect a worse-looking history.
+        self.best_score = max(self.best_score, restored_best)
+        self.state = self.state.replace(skip_count=jnp.zeros((), jnp.int32))
+        if run.rollback_rewarm_steps > 0:
+            from tpuic.train.optimizer import make_optimizer, rewarm_scale
+            steps = max(1, self.train_loader.steps_per_epoch())
+            base_step = int(np.asarray(jax.device_get(self.state.step)))
+            scale = rewarm_scale(base_step, run.rollback_rewarm_steps)
+            self.state = self.state.replace(tx=make_optimizer(
+                self.cfg.optim, steps, run.epochs, lr_scale=scale))
+            # The logged 'lr' metric must report what the optimizer now
+            # APPLIES: fold the ramp into the metric schedule and rebuild
+            # the step around it (one retrace — the same one the new tx
+            # forces anyway). Composed onto the PRISTINE base schedule —
+            # the optimizer rebuild above applies only the newest scale,
+            # so stacking onto an already-scaled self.schedule (rollback
+            # #2 inside rollback #1's ramp) would under-report the LR.
+            from tpuic.train.optimizer import make_schedule
+            base_sched = make_schedule(self.cfg.optim, steps, run.epochs)
+            self.schedule = lambda t: base_sched(t) * scale(t)
+            self.train_step = make_train_step(
+                self.cfg.optim, self.mcfg,
+                self.mesh if self.mesh.size > 1 else None,
+                lr_schedule=self.schedule, seed=self.cfg.run.seed,
+                state_sharding=self.state_sharding)
+            host0_print(f"[rollback] LR re-warming over "
+                        f"{run.rollback_rewarm_steps} steps from step "
+                        f"{base_step}")
+        if self.state_sharding is not None:
+            from tpuic.parallel.sharding import shard_state
+            self.state = shard_state(self.state, self.state_sharding)
+        self.start_epoch = epoch
+        self.start_step = self._validated_start_step()
+        host0_print(f"[rollback] restored '{self.ckpt.last_restore_rung}' — "
+                    f"continuing at epoch {epoch} step {self.start_step} "
+                    f"(rollback {self.rollbacks}/{run.max_rollbacks})")
+        return epoch
+
     def fit(self, epochs: Optional[int] = None) -> float:
         from tpuic.runtime.preemption import agree
         epochs = epochs if epochs is not None else self.cfg.run.epochs
@@ -454,7 +595,8 @@ class Trainer:
         if self.cfg.run.handle_preemption:
             self.preemption.install()
         try:
-            for epoch in range(self.start_epoch, epochs):
+            epoch = self.start_epoch
+            while epoch < epochs:
                 if (self.cfg.run.profile_dir and not profiled
                         and epoch == self.start_epoch):
                     jax.profiler.start_trace(self.cfg.run.profile_dir)
@@ -463,6 +605,15 @@ class Trainer:
                 self.train_epoch(
                     epoch,
                     self.start_step if epoch == self.start_epoch else 0)
+                if self._rollback_pending:
+                    # Non-finite streak past skip_threshold: restore the
+                    # last good checkpoint and continue from ITS epoch.
+                    if profiled:
+                        jax.profiler.stop_trace()
+                        profiled = False
+                    epoch = self._do_rollback()
+                    best = self.best_score
+                    continue
                 # Epoch end is a common boundary: agree so a host whose
                 # local SIGTERM missed the last in-epoch sync point doesn't
                 # diverge from the others (val vs flush).
@@ -499,8 +650,13 @@ class Trainer:
                     best = score
                     self.ckpt.save_best(self.state, epoch, best)
                 self.ckpt.maybe_save_latest(self.state, epoch, best)
+                epoch += 1
         finally:
             self.preemption.uninstall()
-        self.ckpt.wait()  # commit any in-flight async save before returning
+            # Commit any staged save on EVERY exit path: an exception
+            # during epoch N+1 must not strand epoch N's fully-written
+            # checkpoint in '{track}.new' (the restore ladder only reads
+            # committed tracks).
+            self.ckpt.wait()
         self.best_score = best
         return best

@@ -26,8 +26,28 @@ def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int) -> 
     return sched.constant_schedule(cfg.learning_rate)
 
 
+def rewarm_scale(start_step: int, rewarm_steps: int):
+    """LR scale factor ramping linearly 1/N -> 1 over ``rewarm_steps``
+    optimizer steps starting at ``start_step``, then 1 forever.
+
+    The Trainer multiplies this into the schedule after a non-finite
+    rollback (RunConfig.rollback_rewarm_steps): the run re-enters its
+    schedule gently instead of slamming the restored weights with the full
+    LR that just produced the divergence (loss-spike hygiene from the
+    large-batch literature, arXiv:1711.04325)."""
+    n = max(1, int(rewarm_steps))
+    s0 = int(start_step)
+
+    def scale(t):
+        import jax.numpy as jnp
+        return jnp.clip((t - s0 + 1) / n, 1.0 / n, 1.0)
+
+    return scale
+
+
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
-                   total_epochs: int = 100) -> optax.GradientTransformation:
+                   total_epochs: int = 100,
+                   lr_scale=None) -> optax.GradientTransformation:
     # Under gradient accumulation the inner transform's schedule counter
     # advances once per REAL update (1 in K micro-steps), so map it back to
     # micro-step time: lr(t_real) = micro_schedule(t_real * K). Exact for
@@ -35,7 +55,14 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
     # would floor-drift milestones on small datasets), and identical to
     # the Trainer's micro-step logging schedule in data time.
     k = max(1, cfg.grad_accum_steps)
-    micro = make_schedule(cfg, steps_per_epoch, total_epochs)
+    base = make_schedule(cfg, steps_per_epoch, total_epochs)
+    if lr_scale is not None:
+        # Multiplicative override in MICRO-step time (state.step), e.g.
+        # rewarm_scale after a rollback; composed before the accumulation
+        # remap so both see the same clock.
+        micro = lambda t, b=base: b(t) * lr_scale(t)  # noqa: E731
+    else:
+        micro = base
     lr = micro if k == 1 else (lambda t: micro(t * k))
     name = cfg.optimizer.lower()
     if name == "adam":

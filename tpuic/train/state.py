@@ -29,6 +29,14 @@ class TrainState(struct.PyTreeNode):
     # None disables — an empty pytree subtree, so shardings, donation, and
     # checkpoints are unaffected when off.
     ema_params: Any = None
+    # Consecutive non-finite (skipped) steps, maintained IN-GRAPH by the
+    # train step's guard (OptimConfig.skip_nonfinite): 0 after every
+    # applied update, +1 per skip. Living in the state keeps the streak
+    # exact with zero extra host syncs — the Trainer reads it through the
+    # same deferred metrics drain as loss, and rolls back past
+    # RunConfig.skip_threshold. None on states built by older callers;
+    # the guard then still skips, it just can't count streaks.
+    skip_count: Any = None
 
     @property
     def inference_params(self):
@@ -75,4 +83,5 @@ def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
         # under the jitted step's donate_argnums and wedge the executable.
         ema_params=(jax.tree.map(lambda x: jnp.array(x, copy=True), params)
                     if ema else None),
+        skip_count=jnp.zeros((), jnp.int32),
     )

@@ -155,6 +155,25 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     aux_w = model_cfg.aux_loss_weight
     smoothing = optim_cfg.label_smoothing
     remat_policy = resolve_remat_policy(model_cfg)
+    if (donate and optim_cfg.skip_nonfinite
+            and getattr(jax.config, "jax_compilation_cache_dir", None)
+            and jax.default_backend() == "cpu"):
+        # The guard's skip path aliases donated inputs straight to outputs
+        # (state passes through unchanged). Executables DESERIALIZED from
+        # the persistent compilation cache mishandle that aliasing on this
+        # container's jax 0.4.37 CPU backend — measured as both silent
+        # buffer corruption (NaN loss on finite data after a restore) and
+        # nondeterministic SIGSEGV/SIGABRT in dispatch; cache+donate+
+        # guard is the exact trigger, any two of the three are fine.
+        # Scoped to the CPU backend where it was measured: TPU runs (and
+        # any run without a persistent cache — train.py configures none)
+        # keep donation.
+        warnings.warn(
+            "skip_nonfinite guard + persistent compilation cache: "
+            "disabling train-state donation to avoid a known "
+            "aliasing bug in cache-deserialized executables",
+            stacklevel=2)
+        donate = False
 
     def train_step(state: TrainState, batch):
         images, labels = batch["image"], batch["label"]
@@ -305,23 +324,62 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
 
         (loss, (new_stats, logits)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        new_state = state.apply_gradients(grads=grads).replace(
-            batch_stats=new_stats)
-        if optim_cfg.ema_decay > 0 and state.ema_params is not None:
-            d = optim_cfg.ema_decay
-            new_ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p,
-                                   state.ema_params, new_state.params)
-            k = max(1, optim_cfg.grad_accum_steps)
-            if k > 1:
-                # Under gradient accumulation params move only every K-th
-                # micro-step (optax.MultiSteps); advancing the EMA on the
-                # other K-1 would compound the decay to d^K per real
-                # update. Hold it between real updates instead.
-                is_update = ((state.step + 1) % k) == 0
-                new_ema = jax.tree.map(
-                    lambda ne, e: jnp.where(is_update, ne, e),
-                    new_ema, state.ema_params)
-            new_state = new_state.replace(ema_params=new_ema)
+        grad_norm = optax.global_norm(grads)
+
+        def _apply_update(st: TrainState) -> TrainState:
+            new_state = st.apply_gradients(grads=grads).replace(
+                batch_stats=new_stats)
+            if optim_cfg.ema_decay > 0 and st.ema_params is not None:
+                d = optim_cfg.ema_decay
+                new_ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p,
+                                       st.ema_params, new_state.params)
+                k = max(1, optim_cfg.grad_accum_steps)
+                if k > 1:
+                    # Under gradient accumulation params move only every
+                    # K-th micro-step (optax.MultiSteps); advancing the EMA
+                    # on the other K-1 would compound the decay to d^K per
+                    # real update. Hold it between real updates instead.
+                    is_update = ((st.step + 1) % k) == 0
+                    new_ema = jax.tree.map(
+                        lambda ne, e: jnp.where(is_update, ne, e),
+                        new_ema, st.ema_params)
+                new_state = new_state.replace(ema_params=new_ema)
+            return new_state
+
+        if optim_cfg.skip_nonfinite:
+            # Non-finite step guard (docs/robustness.md): keep the update
+            # only when loss AND global grad norm are finite; otherwise the
+            # state passes through UNCHANGED (params, opt_state, BN stats,
+            # EMA, step counter) — one poisoned batch costs one skipped
+            # step, not the run. One compiled program either way, so a NaN
+            # batch causes zero recompiles.
+            #
+            # Implemented as a per-leaf select, NOT lax.cond: a cond whose
+            # skip branch passes donated inputs through to the outputs hits
+            # a buffer-aliasing bug in executables deserialized from the
+            # persistent compilation cache on this container's jax 0.4.37
+            # CPU backend — after a checkpoint restore, steps through the
+            # disk-cached executable read corrupted buffers (NaN loss on
+            # finite data; reproduced and bisected: cache+donate+cond is
+            # the exact trigger, any two of the three are fine). The select
+            # computes the update unconditionally and discards it on skip —
+            # a few elementwise ops on the update path, negligible next to
+            # fwd/bwd. The select's own pass-through aliasing still upsets
+            # cache-deserialized CPU executables intermittently, so the
+            # donate gate above also applies (cpu + cache + guard =>
+            # donate=False); TPU and cache-less runs are untouched.
+            finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            updated = _apply_update(state)
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old), updated, state)
+            if state.skip_count is not None:
+                # Consecutive-skip streak, in-graph (train/state.py): the
+                # Trainer reads it via the deferred metrics drain and rolls
+                # back past RunConfig.skip_threshold.
+                new_state = new_state.replace(skip_count=jnp.where(
+                    finite, 0, state.skip_count + 1).astype(jnp.int32))
+        else:
+            new_state = _apply_update(state)
         acc = accuracy(logits, labels)
         if mask is not None:
             m = mask.astype(jnp.float32)
@@ -329,7 +387,11 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         else:
             acc_mean = jnp.mean(acc)
         metrics = {"loss": loss, "accuracy": acc_mean,
-                   "grad_norm": optax.global_norm(grads)}
+                   "grad_norm": grad_norm}
+        if optim_cfg.skip_nonfinite:
+            metrics["skipped"] = 1.0 - finite.astype(jnp.float32)
+            if new_state.skip_count is not None:
+                metrics["skip_count"] = new_state.skip_count
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         return new_state, metrics

@@ -161,6 +161,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the first epoch here")
     p.add_argument("--log-dir", default="", help="metrics.jsonl directory")
+    p.add_argument("--no-skip-guard", action="store_true",
+                   help="disable the in-graph non-finite step guard (a "
+                        "NaN/Inf batch then poisons the optimizer state "
+                        "permanently — see docs/robustness.md)")
+    p.add_argument("--skip-threshold", type=int, default=10,
+                   help="consecutive non-finite (skipped) steps before the "
+                        "trainer rolls back to the last good checkpoint "
+                        "(0 disables detection)")
+    p.add_argument("--no-rollback", action="store_true",
+                   help="never roll back on a non-finite streak (keep "
+                        "skipping instead)")
+    p.add_argument("--rewarm-steps", type=int, default=0,
+                   help="after a rollback, ramp the LR linearly back to "
+                        "its schedule over this many steps (0 = resume at "
+                        "full schedule LR)")
+    p.add_argument("--no-quarantine", action="store_true",
+                   help="fail fast on undecodable images instead of "
+                        "serving a deterministic same-class replacement")
     return p
 
 
@@ -183,7 +201,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         prefetch=args.prefetch,
                         device_cache_mb=args.device_cache_mb,
                         pack=not args.no_pack, cache_dir=args.cache_dir,
-                        augment=not args.no_augment),
+                        augment=not args.no_augment,
+                        quarantine=not args.no_quarantine),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
                           remat=args.remat, remat_policy=args.remat_policy,
@@ -203,14 +222,18 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           label_smoothing=args.label_smoothing,
                           ema_decay=args.ema_decay,
                           freeze_backbone=args.freeze_backbone,
-                          fused_loss=args.fused_loss),
+                          fused_loss=args.fused_loss,
+                          skip_nonfinite=not args.no_skip_guard),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
                       init_from=args.init_from,
                       log_every_steps=args.log_every_steps,
                       collect_misclassified=args.collect_misclassified,
                       per_class_metrics=args.per_class_metrics,
-                      profile_dir=args.profile_dir, seed=args.seed),
+                      profile_dir=args.profile_dir, seed=args.seed,
+                      skip_threshold=args.skip_threshold,
+                      rollback=not args.no_rollback,
+                      rollback_rewarm_steps=args.rewarm_steps),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
     )
