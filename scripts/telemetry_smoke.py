@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Telemetry smoke gate (ISSUE 3 acceptance; runs in tier-1 CI).
+
+Drives the REAL CLI end to end: builds a synthetic ImageFolder, runs
+``train.py --steps N --metrics-jsonl out.jsonl`` as a subprocess on CPU,
+then asserts the telemetry contract:
+
+- the JSONL parses, with a ``step`` event for every step and the full
+  time breakdown (total/data/dispatch/device) in each;
+- exactly one final goodput report whose named buckets
+  (productive/input/compile/checkpoint/skip/rollback/eval) sum to within
+  2% of the measured wall time — the "where did the time go" ledger must
+  actually add up.
+
+Exit 0 on success; prints the goodput report either way.
+
+    python scripts/telemetry_smoke.py [--steps 5] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STEP_KEYS = {"total_ms", "data_ms", "dispatch_ms", "device_ms"}
+BUCKETS = ("productive", "input", "compile", "checkpoint", "skip",
+           "rollback", "eval")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--tolerance", type=float, default=0.02,
+                   help="max |named buckets - wall| / wall")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the temp workdir for inspection")
+    args = p.parse_args()
+
+    work = tempfile.mkdtemp(prefix="tpuic_tm_smoke_")
+    try:
+        sys.path.insert(0, _REPO)
+        from tpuic.data.synthetic import make_synthetic_imagefolder
+        data = os.path.join(work, "data")
+        # 3 classes x 8 images / batch 2 = 12 steps/epoch: the --steps
+        # budget always stops mid-epoch, so the run is train-only.
+        make_synthetic_imagefolder(data, classes=("a", "b", "c"),
+                                   per_class=8, size=32)
+        jsonl = os.path.join(work, "events.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TF_CPP_MIN_LOG_LEVEL="3")
+        # Enough epochs to cover the budget at 12 steps/epoch (later
+        # epochs include val passes — the eval bucket absorbs them).
+        epochs = args.steps // 12 + 1
+        cmd = [sys.executable, os.path.join(_REPO, "train.py"),
+               "--datadir", data, "--model", "resnet18-cifar",
+               "--resize", "32", "--batchsize", "2",
+               "--epochs", str(epochs),
+               "--optimizer", "adam", "--lr", "1e-3",
+               "--no-class-weights", "--log-every-steps", "1",
+               "--ckpt-dir", os.path.join(work, "cp"),
+               "--steps", str(args.steps), "--metrics-jsonl", jsonl]
+        proc = subprocess.run(cmd, cwd=_REPO, env=env, text=True,
+                              capture_output=True, timeout=1200)
+        if proc.returncode != 0:
+            print(proc.stdout[-2000:])
+            print(proc.stderr[-2000:], file=sys.stderr)
+            print(f"FAIL: train.py exited {proc.returncode}")
+            return 1
+
+        recs = [json.loads(ln) for ln in open(jsonl)]  # must parse
+        steps = [r for r in recs if r["event"] == "step"]
+        assert len(steps) == args.steps, \
+            f"expected {args.steps} step events, got {len(steps)}"
+        assert [r["step"] for r in steps] == list(range(1, args.steps + 1))
+        for r in steps:
+            missing = STEP_KEYS - set(r)
+            assert not missing, f"step {r['step']} missing {missing}"
+
+        finals = [r for r in recs if r["event"] == "goodput"
+                  and r.get("final")]
+        assert len(finals) == 1, f"want 1 final goodput, got {len(finals)}"
+        rep = finals[0]
+        print("goodput:", json.dumps(
+            {k: v for k, v in rep.items() if k not in ("event", "t")},
+            indent=2))
+        named = sum(rep[f"{k}_s"] for k in BUCKETS)
+        wall = rep["wall_s"]
+        assert wall > 0, "empty goodput window"
+        gap = abs(wall - named) / wall
+        print(f"wall {wall:.3f}s, named buckets {named:.3f}s, "
+              f"gap {100 * gap:.2f}% (tolerance "
+              f"{100 * args.tolerance:.0f}%)")
+        assert gap <= args.tolerance, \
+            f"goodput buckets leave {100 * gap:.2f}% of wall unaccounted"
+        print(f"OK: {len(steps)} step events with full breakdown; "
+              f"goodput ledger adds up")
+        return 0
+    finally:
+        if args.keep:
+            print(f"workdir kept: {work}")
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
 
@@ -46,6 +47,7 @@ import orbax.checkpoint as ocp
 
 from tpuic.metrics.logging import host0_print
 from tpuic.runtime import faults as _faults
+from tpuic.telemetry.events import publish as _tm_publish
 
 
 def _flatten(tree, prefix=()) -> Dict[Tuple, Any]:
@@ -228,6 +230,7 @@ class CheckpointManager:
         not at all. ``faults`` point ``ckpt_kill`` fires between the
         finished write and the rotation — the SIGKILL-mid-save simulation:
         the committed track must be untouched by the aborted save."""
+        t0 = time.perf_counter()
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
         pending, self._pending = self._pending, None
@@ -278,6 +281,14 @@ class CheckpointManager:
         _atomic_json(path + ".meta.json",
                      {k: pending[k] for k in
                       ("epoch", "best_score") + RESUME_META_KEYS})
+        # Telemetry (docs/observability.md): the committed checkpoint as
+        # a typed event — the goodput tracker books the blocking commit
+        # span (async-write drain + manifest + rotation) against the
+        # 'checkpoint' bucket.
+        _tm_publish("checkpoint_commit", track=track,
+                    epoch=int(pending["epoch"]), step=int(pending["step"]),
+                    phase="commit",
+                    duration_s=round(time.perf_counter() - t0, 3))
         self._commit_barrier()
 
     @staticmethod
@@ -299,12 +310,19 @@ class CheckpointManager:
               data_seed: int = -1, data_len: int = -1) -> None:
         self.wait()  # one in-flight save at a time; also orders best/latest
         # Stage to {track}.new; wait() rotates it into {track} on commit.
+        t0 = time.perf_counter()
         payload = self._payload(state, epoch, best_score,
                                 step_in_epoch=step_in_epoch,
                                 global_batch=global_batch,
                                 data_seed=data_seed, data_len=data_len)
         self._ckptr.save(os.path.join(self.root, f"{track}.new"), payload,
                          force=True)
+        # The staging span (host gather + async-save handoff) is
+        # checkpoint cost too; the background write itself is free wall
+        # time and is charged at the commit that drains it.
+        _tm_publish("checkpoint_commit", track=track, epoch=int(epoch),
+                    phase="stage",
+                    duration_s=round(time.perf_counter() - t0, 3))
         self._pending = {"track": track, "epoch": int(epoch),
                          "best_score": float(best_score),
                          "step_in_epoch": int(step_in_epoch),

@@ -305,6 +305,24 @@ class RunConfig:
     # stays retrace-free.
     rollback_rewarm_steps: int = 0
     seed: int = 0
+    # -- telemetry (tpuic/telemetry, docs/observability.md) ------------
+    # Stop after this many optimizer steps regardless of epochs (0 = no
+    # cap). Smoke runs and the CI telemetry gate use it; a mid-epoch
+    # stop skips the epoch's val pass.
+    max_steps: int = 0
+    # Telemetry event JSONL sink ('' disables): one line per bus event —
+    # per-step time breakdown, skip/rollback/quarantine/checkpoint
+    # events, compile durations, and the final goodput report.
+    metrics_jsonl: str = ""
+    # Triggered profiler traces (telemetry/tracing.py): when set, a step
+    # slower than trace_threshold x the rolling median starts a
+    # jax.profiler window of trace_steps steps under trace_dir, keeping
+    # at most trace_keep traces. '' disables; the TPUIC_TRACE env var
+    # overrides the dir AND forces one immediate window.
+    trace_dir: str = ""
+    trace_threshold: float = 3.0
+    trace_steps: int = 3
+    trace_keep: int = 4
 
 
 @dataclasses.dataclass(frozen=True)

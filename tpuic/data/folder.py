@@ -187,6 +187,13 @@ class ImageFolderDataset:
         with self._quarantine_lock:
             self.quarantine_count += 1
             self.quarantined[path] = self.quarantined.get(path, 0) + 1
+            count = self.quarantine_count
+        # Typed event (docs/observability.md): fires from the producer
+        # thread at the moment of replacement, so the TensorBoard bridge
+        # and JSONL sink see corruption when it happens, not only at the
+        # trainer's per-epoch summary line.
+        from tpuic.telemetry.events import publish as _tm_publish
+        _tm_publish("quarantine", path=path, count=count)
 
     def load(self, index: int, rng: Optional[np.random.Generator] = None
              ) -> Tuple[np.ndarray, int, str]:

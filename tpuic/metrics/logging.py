@@ -43,6 +43,12 @@ class MetricLogger:
             from tpuic.metrics.tensorboard import TensorBoardWriter
             self._tb = TensorBoardWriter(self.root)
 
+    @property
+    def tb(self):
+        """The active TensorBoardWriter (None when logging is off) — the
+        telemetry TensorBoardSink bridges bus events through it."""
+        return self._tb
+
     def write(self, step: int, **scalars) -> None:
         if self._fh is None:
             return

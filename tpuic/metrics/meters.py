@@ -80,6 +80,16 @@ class LatencyMeter:
     def mean_ms(self) -> float:
         return 1000.0 * self.total / self.count if self.count else 0.0
 
+    @property
+    def std_ms(self) -> float:
+        """Population std (ms) over the window — the spread companion to
+        ``percentiles_ms`` (bench.py's per-step variance detail)."""
+        if not self._win:
+            return 0.0
+        import numpy as np
+        return round(1000.0 * float(np.std(np.asarray(self._win,
+                                                      np.float64))), 3)
+
 
 def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Per-sample 0/1 correctness; reference utils.py:25-27.

@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import socket
 import struct
+import threading
 import time
 from typing import Iterator, List, Optional, Tuple
 
@@ -109,15 +110,25 @@ class TensorBoardWriter:
         os.makedirs(log_dir, exist_ok=True)
         name = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
         self._fh = open(os.path.join(log_dir, name), "ab")
+        # Records interleave from more than one thread since the telemetry
+        # bridge landed: the loop thread writes scalars while the
+        # TensorBoardSink relays quarantine events from dataset producer
+        # threads. A record is four sequential writes (header, CRC,
+        # payload, CRC) — unserialized interleaving corrupts the CRC
+        # framing and truncates the file for every reader.
+        self._lock = threading.Lock()
         self._record(_event(0, file_version="brain.Event:2"))
 
     def _record(self, payload: bytes) -> None:
         header = struct.pack("<Q", len(payload))
-        self._fh.write(header)
-        self._fh.write(struct.pack("<I", _masked_crc(header)))
-        self._fh.write(payload)
-        self._fh.write(struct.pack("<I", _masked_crc(payload)))
-        self._fh.flush()
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(header)
+            self._fh.write(struct.pack("<I", _masked_crc(header)))
+            self._fh.write(payload)
+            self._fh.write(struct.pack("<I", _masked_crc(payload)))
+            self._fh.flush()
 
     def scalars(self, step: int, **values: float) -> None:
         if values:
@@ -125,9 +136,10 @@ class TensorBoardWriter:
                 (k, float(v)) for k, v in sorted(values.items()))))
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
 
 # -- independent reader (tests + debugging) ----------------------------------

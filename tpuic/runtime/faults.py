@@ -27,6 +27,11 @@ Registered points (site → meaning of ``step``):
 - ``hang_device``   — InferenceEngine._dispatch (serve/engine.py): sleep
                       ``param`` seconds before the device call — a stuck
                       device call for drain-timeout tests.
+- ``slow_step``     — train loop: sleep ``param`` seconds (default 0.05)
+                      before dispatching this step — a deterministic
+                      step-time regression for the telemetry trace
+                      trigger (telemetry/tracing.py). ``step`` is the
+                      host-tracked global optimizer step.
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of

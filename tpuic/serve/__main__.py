@@ -180,6 +180,18 @@ def main(argv=None) -> int:
                    help="on SIGTERM/SIGINT, wait up to this many seconds "
                         "for in-flight requests before failing stragglers "
                         "with an error line and exiting")
+    p.add_argument("--prom-port", type=int, default=0,
+                   help="serve a Prometheus /metrics endpoint on this "
+                        "port (queue wait, pad efficiency, latency "
+                        "percentiles from the shared meter; 0 disables)")
+    p.add_argument("--prom-host", default="127.0.0.1",
+                   help="interface for --prom-port (loopback by default "
+                        "— the endpoint is unauthenticated; bind "
+                        "0.0.0.0 only behind a firewall)")
+    p.add_argument("--prom-dump", default="",
+                   help="write the Prometheus text exposition to this "
+                        "file on shutdown (and each poll tick under "
+                        "--watch) — the textfile-collector transport")
     args = p.parse_args(argv)
 
     # Install the latch BEFORE the (potentially minutes-long) checkpoint
@@ -197,6 +209,22 @@ def main(argv=None) -> int:
     engine, size, num_classes, model_name = build_engine(args)
     names = _class_names(args.ckpt_dir, model_name, num_classes,
                          args.classes)
+
+    # Prometheus exposition (telemetry/prom.py): counters come straight
+    # from engine.stats — the shared LatencyMeter percentiles, pad
+    # efficiency, bucket histogram, compile counts.
+    from tpuic.telemetry.prom import (PromServer, serve_exposition,
+                                      write_exposition)
+
+    def _prom_text() -> str:
+        return serve_exposition(engine.stats.snapshot())
+
+    prom_server = None
+    if args.prom_port:
+        prom_server = PromServer(args.prom_port, _prom_text,
+                                 host=args.prom_host)
+        print(f"[serve] prometheus /metrics on "
+              f"{args.prom_host}:{prom_server.port}", file=sys.stderr)
     k = max(1, min(args.top_k, num_classes))
     out = open(args.out, "w") if args.out else sys.stdout
     pending = deque()  # (id, Future) in submission order
@@ -311,6 +339,16 @@ def main(argv=None) -> int:
                         if args.once or attempts[f] >= 3:
                             seen.add(f)
                 drain(block=False)
+                if args.prom_dump:
+                    # Per-tick refresh: a textfile collector scraping the
+                    # dump sees live counters, not only the final state.
+                    # Guarded: monitoring must never take down serving
+                    # (disk-full on the textfile path is not our outage).
+                    try:
+                        write_exposition(args.prom_dump, _prom_text())
+                    except OSError as e:
+                        print(f"[serve] prom dump failed: {e}",
+                              file=sys.stderr)
                 if args.once and not fresh and not pending:
                     break
                 if args.once:
@@ -387,6 +425,15 @@ def main(argv=None) -> int:
     finally:
         guard.uninstall()
         engine.close(timeout=max(5.0, args.drain_timeout))
+        if prom_server is not None:
+            prom_server.close()
+        if args.prom_dump:
+            try:
+                write_exposition(args.prom_dump, _prom_text())
+                print(f"[serve] prometheus exposition -> {args.prom_dump}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"[serve] prom dump failed: {e}", file=sys.stderr)
         print(f"[serve] served {served} requests; stats: "
               f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)
         if out is not sys.stdout:

@@ -52,6 +52,7 @@ import numpy as np
 
 from tpuic.runtime import faults as _faults
 from tpuic.serve.metrics import ServeStats
+from tpuic.telemetry.events import publish as _tm_publish
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
@@ -396,13 +397,13 @@ class InferenceEngine:
                                    [now - r.t_enqueue for r in reqs])
         exe = self._executable_for(bucket)
         out = exe(self._variables, self._jax.device_put(batch))
-        return reqs, out
+        return reqs, out, bucket
 
     def _resolve(self, inflight) -> None:
         """Block on device->host readback, slice per request, resolve
         futures.  Rows >= the batch's valid count are padding and are
         never part of any slice."""
-        reqs, out = inflight
+        reqs, out, bucket = inflight
         try:
             # Async-dispatch contract: device-side errors surface HERE,
             # not at dispatch — so this readback is also the error edge.
@@ -415,8 +416,15 @@ class InferenceEngine:
         now = time.monotonic()
         # Counters first: a caller woken by set_result may snapshot stats
         # immediately, and the batch it just completed must be in them.
-        self.stats.record_done(len(reqs), sum(r.n for r in reqs),
-                               [now - r.t_enqueue for r in reqs])
+        latencies = [now - r.t_enqueue for r in reqs]
+        valid = sum(r.n for r in reqs)
+        self.stats.record_done(len(reqs), valid, latencies)
+        # Typed event per completed device batch (docs/observability.md):
+        # the in-band record of what the micro-batcher decided, published
+        # from the batcher thread (the bus is thread-safe; idle = free).
+        _tm_publish("serve_batch", bucket=int(bucket), requests=len(reqs),
+                    images=int(valid),
+                    latency_ms=round(1000.0 * max(latencies), 3))
         off = 0
         for r in reqs:
             lo, hi = off, off + r.n

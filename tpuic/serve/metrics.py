@@ -26,6 +26,12 @@ from typing import Dict
 
 from tpuic.metrics import LatencyMeter
 
+# Re-export shim: the percentile meter is owned by tpuic.metrics.meters
+# (ONE implementation shared by serve stats, the telemetry StepTimer,
+# and bench.py's per-step spread); ``from tpuic.serve.metrics import
+# LatencyMeter`` keeps working for existing callers.
+__all__ = ["LatencyMeter", "ServeStats"]
+
 
 class ServeStats:
     """Thread-safe counters for one InferenceEngine."""

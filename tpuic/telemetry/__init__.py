@@ -1,0 +1,133 @@
+"""tpuic.telemetry — unified observability subsystem.
+
+The reference repo's only observability was an ``AverageMeter`` printed
+per epoch; this reproduction grew a trainer, a serving engine, and a
+fault-tolerance layer that each invented their own measurement (deferred
+log drain, ServeStats, bench-script MFU math).  This package makes the
+measurement a first-class subsystem — the layer every perf PR cites for
+before/after evidence (docs/observability.md):
+
+- ``events``   — structured publish/subscribe **event bus** with JSONL /
+  in-memory / TensorBoard sinks.  train/loop.py, checkpoint/manager.py,
+  data/folder.py, and serve/engine.py emit typed events (``step``,
+  ``epoch``, ``eval``, ``checkpoint_commit``, ``rollback``, ``skip``,
+  ``quarantine``, ``compile``, ``serve_batch``, ``trace``, ``goodput``)
+  into it instead of ad-hoc log lines.
+- ``steptime`` — per-step wall-clock **breakdown** (data-wait vs.
+  dispatch vs. device) from dispatch timestamps + the existing deferred
+  drain: zero new host syncs, zero new compiles (asserted in
+  tests/test_telemetry.py, the PR-2 discipline).
+- ``goodput``  — per-model analytic FLOPs (bench.py's math, now owned
+  here and imported back by bench.py), running MFU, and a goodput
+  report classifying wall time into productive / compile / checkpoint /
+  skip / rollback / input-bound / eval buckets.
+- ``tracing``  — triggered ``jax.profiler`` windows: arms automatically
+  when step time regresses past a multiple of the rolling median (or
+  via ``TPUIC_TRACE=dir``), writing to a bounded trace dir.
+- ``prom``     — Prometheus-style text exposition of serve and train
+  counters (``python -m tpuic.serve --prom-dump/--prom-port``).
+
+Everything is host-side: no module here ever calls ``jax.device_get``
+or adds device work (test-asserted), so telemetry can stay on in
+production hot loops.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpuic.telemetry.events import (Event, EventBus, JsonlSink,  # noqa: F401
+                                    MemorySink, TensorBoardSink, bus,
+                                    install_jax_compile_listener, publish,
+                                    subscribe)
+from tpuic.telemetry.goodput import (GoodputTracker,  # noqa: F401
+                                     PEAK_FLOPS, analytic_flops_per_step,
+                                     peak_flops)
+from tpuic.telemetry.steptime import StepTimer  # noqa: F401
+from tpuic.telemetry.tracing import TraceTrigger  # noqa: F401
+
+
+class TrainTelemetry:
+    """One training run's telemetry wiring over the process-global bus.
+
+    Owns the per-run subscribers (JSONL sink, step timer, goodput
+    tracker, trace trigger, TensorBoard bridge); the emitters
+    (checkpoint manager, dataset quarantine, jax compile listener)
+    publish to the global bus without knowing any of this exists.
+
+    Exactly one instance is live per process: constructing a new one
+    closes the previous run's subscribers first, so a sweep driver (or
+    a test session) building Trainer after Trainer never leaks bus
+    subscriptions or appends run B's events into run A's JSONL file.
+    """
+
+    def __init__(self, run_cfg, *, model_name: str = "", image_size: int = 0,
+                 global_batch: int = 0, n_devices: int = 1, device=None,
+                 tb=None) -> None:
+        global _active
+        if _active is not None:
+            _active.close()
+        _active = self
+        self._sinks = []
+        self._unsubs = []
+        # Compile events (the jax.monitoring bridge) feed the goodput
+        # compile bucket; idempotent, process-wide.
+        install_jax_compile_listener()
+        jsonl = getattr(run_cfg, "metrics_jsonl", "") or ""
+        if jsonl:
+            # Host-0 only, the MetricLogger rule: on a multi-host pod
+            # every process runs the loop and would otherwise append its
+            # own events (and its own final goodput report) into the
+            # same file on the shared filesystem.
+            from tpuic.metrics.logging import is_host0
+            if is_host0():
+                sink = JsonlSink(jsonl)
+                self._sinks.append(sink)
+                self._unsubs.append(bus.subscribe(sink))
+        self.steptime = StepTimer(bus)
+        flops = analytic_flops_per_step(model_name, image_size, global_batch)
+        peak = peak_flops(device) * max(1, int(n_devices))
+        self.goodput = GoodputTracker(flops_per_step=flops, peak_flops=peak,
+                                      global_batch=global_batch)
+        self._unsubs.append(bus.subscribe(self.goodput.on_event))
+        trace_dir = os.environ.get("TPUIC_TRACE", "") or \
+            getattr(run_cfg, "trace_dir", "") or ""
+        self.tracer: Optional[TraceTrigger] = None
+        if trace_dir:
+            self.tracer = TraceTrigger(
+                trace_dir,
+                threshold=float(getattr(run_cfg, "trace_threshold", 3.0)),
+                trace_steps=int(getattr(run_cfg, "trace_steps", 3)),
+                keep=int(getattr(run_cfg, "trace_keep", 4)),
+                # TPUIC_TRACE=dir is the manual override: capture one
+                # window immediately instead of waiting for a regression.
+                force_first=bool(os.environ.get("TPUIC_TRACE")))
+            self._unsubs.append(bus.subscribe(self.tracer.on_event,
+                                              kinds=("step",)))
+        if tb is not None:
+            tbs = TensorBoardSink(tb)
+            self._unsubs.append(bus.subscribe(
+                tbs, kinds=("step", "skip", "rollback", "quarantine",
+                            "goodput")))
+
+    def flush(self) -> None:
+        for s in self._sinks:
+            s.flush()
+
+    def close(self) -> None:
+        """Unsubscribe this run's consumers and close its sinks (the
+        global bus and emitters keep running for the process).
+        Idempotent."""
+        global _active
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs = []
+        for s in self._sinks:
+            s.close()
+        self._sinks = []
+        if _active is self:
+            _active = None
+
+
+_active: Optional[TrainTelemetry] = None

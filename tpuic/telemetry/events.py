@@ -1,0 +1,270 @@
+"""Structured event bus: lightweight publish/subscribe of typed events.
+
+The train loop, checkpoint manager, dataset quarantine, serving engine,
+and the jax compile monitor all publish here instead of (only) printing
+ad-hoc log lines; sinks subscribe — JSONL for machines, memory for
+tests, TensorBoard for dashboards.  docs/observability.md documents the
+event schema.
+
+Design constraints (the hot-loop discipline):
+
+- **Free when idle**: ``publish`` on a bus with no subscribers is one
+  attribute read and a falsy check — telemetry wiring can stay in the
+  per-step path unconditionally.
+- **Host-only**: nothing in this module touches JAX arrays.  Event data
+  values must be plain JSON-able scalars/strings the caller already has
+  on host; publishing never forces a device sync (test-asserted).
+- **Thread-safe**: emitters run in producer threads, the serve batcher,
+  and the train loop; subscription mutates under a lock while publish
+  reads an immutable snapshot tuple.
+- **Sink failures are contained**: a sink raising must not take down
+  the training step or the batcher — the error is counted
+  (``bus.sink_errors``) and the event is delivered to the remaining
+  subscribers.
+
+This module deliberately imports neither jax nor numpy, so low-level
+emitters (data/folder.py, checkpoint/manager.py) can import it with no
+dependency cost; the jax.monitoring bridge imports jax lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+# The typed vocabulary (ISSUE 3).  Publishing an unlisted kind is allowed
+# (the bus is a transport, not a validator) but the canonical emitters
+# stick to these; docs/observability.md is the schema reference.
+EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
+               "rollback", "skip", "quarantine", "compile", "serve_batch",
+               "trace", "goodput")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    kind: str
+    time: float          # wall clock (time.time()) at publish
+    data: Dict[str, object]
+
+
+class EventBus:
+    """Synchronous pub/sub.  Subscribers run inline in the publishing
+    thread (ordering is therefore the emission order); anything slow or
+    blocking belongs in the subscriber's own buffering, not here."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # Immutable snapshot: publish iterates without the lock.
+        self._subs: Tuple[Tuple[Optional[frozenset], Callable], ...] = ()
+        self.published = 0
+        self.sink_errors = 0
+
+    def subscribe(self, fn: Callable[[Event], None],
+                  kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
+        """Register ``fn`` for ``kinds`` (None = every kind); returns an
+        idempotent unsubscribe callable."""
+        entry = (None if kinds is None else frozenset(kinds), fn)
+        with self._lock:
+            self._subs = self._subs + (entry,)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                self._subs = tuple(e for e in self._subs if e is not entry)
+        return unsubscribe
+
+    def active(self, kind: Optional[str] = None) -> bool:
+        """Whether anything would receive ``kind`` (None: any subscriber
+        at all) — lets emitters skip building expensive event data."""
+        subs = self._subs
+        if kind is None:
+            return bool(subs)
+        return any(k is None or kind in k for k, _ in subs)
+
+    def publish(self, kind: str, **data) -> Optional[Event]:
+        subs = self._subs
+        if not subs:
+            return None
+        ev = Event(kind, time.time(), data)
+        delivered = False
+        for kinds, fn in subs:
+            if kinds is not None and kind not in kinds:
+                continue
+            delivered = True
+            try:
+                fn(ev)
+            except Exception:
+                # A broken sink must never kill the train loop or the
+                # serve batcher; the counter makes the breakage visible.
+                self.sink_errors += 1
+        if delivered:
+            self.published += 1
+        return ev
+
+    def reset(self) -> None:
+        """Drop every subscriber (test isolation — the process-global
+        bus otherwise accumulates them across constructed Trainers)."""
+        with self._lock:
+            self._subs = ()
+            self.published = 0
+            self.sink_errors = 0
+
+
+# -- sinks -------------------------------------------------------------------
+class MemorySink:
+    """Bounded in-memory event recorder (tests, REPL debugging)."""
+
+    def __init__(self, maxlen: int = 4096) -> None:
+        self.events: deque = deque(maxlen=maxlen)
+
+    def __call__(self, ev: Event) -> None:
+        self.events.append(ev)
+
+    def kinds(self) -> list:
+        return [e.kind for e in self.events]
+
+    def of(self, kind: str) -> list:
+        return [e for e in self.events if e.kind == kind]
+
+
+class JsonlSink:
+    """One JSON line per event: ``{"event": kind, "t": ..., **data}``.
+
+    ``flush_every`` bounds buffered lines (1 = flush each event — the
+    default, so a killed process loses nothing; per-line flush of an
+    already-buffered file is microseconds against millisecond steps).
+    Thread-safe: serve-thread and loop-thread events interleave whole
+    lines, never bytes.
+    """
+
+    def __init__(self, path: str, flush_every: int = 1) -> None:
+        self.path = path
+        self._fh = open(path, "a")
+        self._lock = threading.Lock()
+        self._since_flush = 0
+        self._flush_every = max(1, int(flush_every))
+
+    def __call__(self, ev: Event) -> None:
+        rec = {"event": ev.kind, "t": round(ev.time, 6), **ev.data}
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line)
+            self._since_flush += 1
+            if self._since_flush >= self._flush_every:
+                self._fh.flush()
+                self._since_flush = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._since_flush = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class TensorBoardSink:
+    """Bus -> TensorBoard bridge: skip/rollback/quarantine counts and
+    goodput fractions become scalars instead of being log-only.
+
+    Wraps an existing ``tpuic.metrics.tensorboard.TensorBoardWriter``
+    (the MetricLogger's); subscribes to ``step`` only to track the
+    current global step so step-less events (quarantine fires in a
+    producer thread) land at a sensible x-coordinate.
+    """
+
+    def __init__(self, writer) -> None:
+        self._tb = writer
+        self._step = 0
+        self._quarantined = 0
+        self._rollbacks = 0
+
+    def __call__(self, ev: Event) -> None:
+        if self._tb is None:
+            return
+        d = ev.data
+        if ev.kind == "step":
+            self._step = int(d.get("step", self._step))
+            return
+        if ev.kind == "skip":
+            self._tb.scalars(int(d.get("step", self._step)),
+                             skip_streak=float(d.get("streak", 0)))
+        elif ev.kind == "rollback":
+            self._rollbacks += 1
+            self._tb.scalars(self._step, rollbacks=float(self._rollbacks))
+        elif ev.kind == "quarantine":
+            # Accumulate per event rather than trusting the publisher's
+            # 'count': that figure is dataset-local (train and val each
+            # keep their own), so taking the last event's value would
+            # regress the scalar whenever more than one dataset (or an
+            # out-of-order producer thread) quarantines.
+            self._quarantined += 1
+            self._tb.scalars(self._step,
+                             quarantined_total=float(self._quarantined))
+        elif ev.kind == "goodput":
+            scalars = {f"goodput_{k[5:]}": float(v) for k, v in d.items()
+                       if k.startswith("frac_")}
+            if "mfu" in d and d["mfu"] is not None:
+                scalars["mfu"] = float(d["mfu"])
+            if scalars:
+                self._tb.scalars(int(d.get("step", self._step)), **scalars)
+
+
+# -- the process-global bus --------------------------------------------------
+bus = EventBus()
+
+
+def publish(kind: str, **data) -> Optional[Event]:
+    return bus.publish(kind, **data)
+
+
+def subscribe(fn: Callable[[Event], None],
+              kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
+    return bus.subscribe(fn, kinds)
+
+
+# -- jax.monitoring bridge ---------------------------------------------------
+_COMPILE_PREFIX = "/jax/core/compile/"
+_monitor_lock = threading.Lock()
+_monitor_installed = False
+
+
+def install_jax_compile_listener() -> bool:
+    """Bridge jax's compile-duration monitoring into ``compile`` events.
+
+    jax 0.4.x reports each compilation as three sequential phase
+    durations (jaxpr trace, MLIR lowering, backend compile) under
+    ``/jax/core/compile/*``; the listener republishes each phase as a
+    ``compile`` event (``key``, ``duration_s``), so the goodput tracker
+    can subtract compile time from the step it stalled and tests can
+    count ``backend_compile`` events as a compile counter.  Idempotent;
+    returns False when jax.monitoring is unavailable.  The listener is
+    process-wide and permanent (jax has no unregister), but an idle bus
+    makes each callback a single falsy check.
+    """
+    global _monitor_installed
+    with _monitor_lock:
+        if _monitor_installed:
+            return True
+        try:
+            from jax import monitoring as _jm
+        except Exception:
+            return False
+
+        def _listener(key: str, duration: float, **kw) -> None:
+            if key.startswith(_COMPILE_PREFIX):
+                publish("compile", key=key[len(_COMPILE_PREFIX):],
+                        duration_s=round(float(duration), 6))
+
+        _jm.register_event_duration_secs_listener(_listener)
+        _monitor_installed = True
+        return True

@@ -1,0 +1,178 @@
+"""Prometheus text exposition of serve and train counters.
+
+No client library (the container bakes none in): the exposition format
+is lines of ``name{label="v"} value`` with ``# HELP``/``# TYPE``
+comments — trivially hand-rendered and accepted by any Prometheus
+scraper or ``promtool check metrics``.
+
+Two producers:
+
+- ``serve_exposition(stats.snapshot())`` — the InferenceEngine's
+  counters: queue-wait and latency percentiles (sourced from the shared
+  ``tpuic.metrics.LatencyMeter``), pad efficiency, bucket histogram,
+  compile/cache counters, throughput.
+- ``train_exposition(goodput.report(), steptime.summary())`` — goodput
+  fractions, MFU, step-time percentiles.
+
+Transport is the caller's choice: ``write_exposition`` dumps to a file
+(``--prom-dump``, scrapeable via node_exporter's textfile collector),
+``PromServer`` serves ``/metrics`` over HTTP (``--prom-port``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Iterable, List, Optional, Tuple
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render(rows: Iterable[Tuple], prefix: str = "tpuic") -> str:
+    """rows: (name, value, type, help, labels-or-None).  Values of None
+    are skipped (a percentile with no samples yet must not render as a
+    bogus 0).  TYPE/HELP are emitted once per metric name."""
+    seen = set()
+    out: List[str] = []
+    for name, value, mtype, help_, labels in rows:
+        if value is None:
+            continue
+        full = f"{prefix}_{name}"
+        if full not in seen:
+            seen.add(full)
+            out.append(f"# HELP {full} {help_}")
+            out.append(f"# TYPE {full} {mtype}")
+        out.append(f"{full}{_fmt_labels(labels)} {float(value):g}")
+    return "\n".join(out) + "\n" if out else ""
+
+
+def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve") -> str:
+    """ServeStats.snapshot() -> Prometheus text."""
+    rows: List[Tuple] = [
+        ("requests_total", snapshot.get("requests"), "counter",
+         "requests resolved", None),
+        ("images_total", snapshot.get("images"), "counter",
+         "images scored", None),
+        ("device_calls_total", snapshot.get("device_calls"), "counter",
+         "bucketed device dispatches", None),
+        ("rejected_total", snapshot.get("rejected"), "counter",
+         "requests rejected by queue backpressure", None),
+        ("compiles_total", snapshot.get("compiles"), "counter",
+         "bucket executable compiles (0 after warmup = the AOT contract)",
+         None),
+        ("executable_cache_hits_total", snapshot.get("executable_cache_hits"),
+         "counter", "steady-state executable cache hits", None),
+        ("compile_seconds_total", snapshot.get("compile_s"), "counter",
+         "cumulative compile wall time", None),
+        ("pad_efficiency", snapshot.get("pad_efficiency"), "gauge",
+         "valid rows / device rows (1.0 = no padding waste)", None),
+        ("throughput_images_per_sec", snapshot.get(
+            "throughput_images_per_sec"), "gauge",
+         "lifetime images/sec", None),
+        ("elapsed_seconds", snapshot.get("elapsed_s"), "gauge",
+         "seconds since stats reset", None),
+    ]
+    for src, name, help_ in (
+            ("queue_wait_ms", "queue_wait_ms",
+             "enqueue->dispatch wait percentiles over the sliding window"),
+            ("latency_ms", "latency_ms",
+             "enqueue->result latency percentiles over the sliding window")):
+        for q, v in (snapshot.get(src) or {}).items():
+            rows.append((name, v, "gauge", help_, {"quantile": q}))
+    for bucket, n in (snapshot.get("batch_hist") or {}).items():
+        rows.append(("batches_total", n, "counter",
+                     "device calls per padding bucket", {"bucket": bucket}))
+    return render(rows, prefix=prefix)
+
+
+def train_exposition(report: dict, steptime: Optional[dict] = None,
+                     prefix: str = "tpuic_train") -> str:
+    """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text."""
+    rows: List[Tuple] = [
+        ("steps_total", report.get("steps"), "counter",
+         "train steps dispatched", None),
+        ("wall_seconds", report.get("wall_s"), "gauge",
+         "goodput window wall time", None),
+        ("mfu", report.get("mfu"), "gauge",
+         "running model FLOPs utilization (analytic)", None),
+        ("compiles_total", report.get("compiles"), "counter",
+         "backend compiles observed (flat after step 1 = no retraces)",
+         None),
+        ("skipped_steps", report.get("skipped_steps_est"), "counter",
+         "estimated non-finite guard-skipped steps", None),
+        ("goodput_accounted_fraction", report.get("accounted_frac"),
+         "gauge", "fraction of wall time the named buckets explain", None),
+    ]
+    for k, v in report.items():
+        if k.startswith("frac_"):
+            rows.append(("goodput_fraction", v, "gauge",
+                         "fraction of wall time per goodput bucket",
+                         {"bucket": k[5:]}))
+    for src, name in ((steptime or {}).get("total_ms"), "step_total_ms"), \
+                     ((steptime or {}).get("data_ms"), "step_data_wait_ms"):
+        for q, v in (src or {}).items():
+            rows.append((name, v, "gauge",
+                         "step-time percentiles over the sliding window",
+                         {"quantile": q}))
+    return render(rows, prefix=prefix)
+
+
+def write_exposition(path: str, text: str) -> None:
+    """Atomic dump (textfile-collector discipline: scrapers must never
+    read a half-written exposition)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+class PromServer:
+    """Minimal /metrics HTTP endpoint around a ``collect() -> str``
+    callable; runs in a daemon thread, ``close()`` shuts it down.
+
+    Binds loopback by default (the node_exporter convention): the
+    endpoint has no auth, so exposing it beyond the host is an explicit
+    caller decision (``--prom-host`` in ``python -m tpuic.serve``)."""
+
+    def __init__(self, port: int, collect: Callable[[], str],
+                 host: str = "127.0.0.1") -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self_inner):  # noqa: N805
+                if self_inner.path.rstrip("/") not in ("", "/metrics"):
+                    self_inner.send_response(404)
+                    self_inner.end_headers()
+                    return
+                try:
+                    body = collect().encode()
+                except Exception as e:  # collector bug -> 500, not crash
+                    self_inner.send_response(500)
+                    self_inner.end_headers()
+                    self_inner.wfile.write(str(e).encode())
+                    return
+                self_inner.send_response(200)
+                self_inner.send_header(
+                    "Content-Type", "text/plain; version=0.0.4")
+                self_inner.send_header("Content-Length", str(len(body)))
+                self_inner.end_headers()
+                self_inner.wfile.write(body)
+
+            def log_message(self_inner, *a):  # quiet: stderr is for stats
+                pass
+
+        self._srv = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = self._srv.server_address[1]  # resolved (port 0 = any)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="tpuic-prom")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)

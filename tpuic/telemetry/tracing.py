@@ -1,0 +1,164 @@
+"""Triggered profiler traces: capture the regression, not the baseline.
+
+A standing ``jax.profiler`` trace is too heavy to leave on, and a trace
+started by hand always misses the incident.  ``TraceTrigger`` watches
+step totals (the ``step`` events the StepTimer publishes) and arms a
+bounded trace window automatically when a step regresses past a
+configurable multiple of the rolling median — so the trace on disk is
+of the slow steps, captured while they were slow.
+
+Semantics (docs/observability.md):
+
+- **Trigger**: after ``warmup`` observed steps, a step whose total
+  exceeds ``threshold x median(recent window)`` starts a trace that
+  covers the next ``trace_steps`` steps.  The triggering step itself is
+  already over (its timestamps are host-side history); regressions this
+  exists for (input stall, new retrace, contended chip) persist across
+  steps, which is exactly why a median trigger works.
+- **Manual**: ``TPUIC_TRACE=dir`` (env) forces one window immediately
+  at run start — the "trace me now" override, no regression needed.
+- **Bounded**: traces land in ``trace_dir/trace-NNNN``; at most
+  ``keep`` are retained (oldest deleted first), so a flapping trigger
+  cannot fill a disk.
+- **Cooldown**: after a window closes, the trigger sleeps for
+  ``cooldown`` steps so one sustained regression yields one trace, not
+  a trace per step.
+- Every transition publishes a ``trace`` event
+  (``action``: started/stopped/error, ``path``, ``reason``/``ratio``).
+
+A failure to start/stop the profiler (e.g. the fit-level
+``--profile-dir`` trace already active) is published as an error event
+and disables the trigger — observability must never kill the run.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import time
+from collections import deque
+from typing import Optional
+
+
+class TraceTrigger:
+    def __init__(self, trace_dir: str, threshold: float = 3.0,
+                 window: int = 64, warmup: int = 5, trace_steps: int = 3,
+                 keep: int = 4, cooldown: int = 16, bus=None,
+                 force_first: bool = False) -> None:
+        if bus is None:
+            from tpuic.telemetry.events import bus as _global_bus
+            bus = _global_bus
+        self.bus = bus
+        self.trace_dir = trace_dir
+        self.threshold = float(threshold)
+        self.warmup = max(2, int(warmup))
+        self.trace_steps = max(1, int(trace_steps))
+        self.keep = max(1, int(keep))
+        self.cooldown = max(0, int(cooldown))
+        self._totals: deque = deque(maxlen=max(8, int(window)))
+        self._active_path: Optional[str] = None
+        self._remaining = 0
+        self._cooldown_left = 0
+        self._counter = 0
+        self._force = bool(force_first)
+        self._disabled = False
+        self.fired = 0
+
+    # -- bus hook ------------------------------------------------------
+    def on_event(self, ev) -> None:
+        if ev.kind == "step":
+            self.observe(float(ev.data.get("total_ms", 0.0)) / 1000.0)
+
+    def observe(self, total_s: float) -> None:
+        """One step's total wall time; called from the loop thread (the
+        profiler start/stop must stay on one thread)."""
+        if self._disabled:
+            return
+        if self._active_path is not None:
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self._stop()
+            return
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._totals.append(total_s)
+            return
+        if self._force:
+            self._force = False
+            self._totals.append(total_s)
+            self._start(reason="TPUIC_TRACE", ratio=None)
+            return
+        ratio = None
+        if (self.threshold > 0 and len(self._totals) >= self.warmup):
+            med = statistics.median(self._totals)
+            if med > 0 and total_s > self.threshold * med:
+                ratio = total_s / med
+        self._totals.append(total_s)
+        if ratio is not None:
+            self._start(reason="slow_step", ratio=round(ratio, 2))
+
+    def finish(self) -> None:
+        """Close any open window (end of fit — a trace must never leak
+        past the run that started it)."""
+        if self._active_path is not None:
+            self._stop()
+
+    # -- internals -----------------------------------------------------
+    def _prune(self) -> None:
+        # Oldest-first by mtime, NOT by name: the dir name starts with a
+        # per-run counter, so across process restarts a fresh run's
+        # trace-0000 sorts before the previous run's trace-0003 and a
+        # name sort would delete the evidence just captured while
+        # keeping the stale traces.
+        try:
+            names = [d for d in os.listdir(self.trace_dir)
+                     if d.startswith("trace-")]
+        except OSError:
+            return
+
+        def age(d: str):
+            try:
+                return os.path.getmtime(os.path.join(self.trace_dir, d))
+            except OSError:
+                return 0.0
+        names.sort(key=lambda d: (age(d), d))
+        for d in names[:max(0, len(names) - (self.keep - 1))]:
+            shutil.rmtree(os.path.join(self.trace_dir, d),
+                          ignore_errors=True)
+
+    def _start(self, reason: str, ratio) -> None:
+        os.makedirs(self.trace_dir, exist_ok=True)
+        self._prune()
+        path = os.path.join(self.trace_dir,
+                            f"trace-{self._counter:04d}-{int(time.time())}")
+        self._counter += 1
+        try:
+            import jax
+            jax.profiler.start_trace(path)
+        except Exception as e:
+            # Another trace active (fit --profile-dir) or a backend
+            # without profiler support: report and stand down.
+            self._disabled = True
+            self.bus.publish("trace", action="error", path=path,
+                             reason=str(e)[:200])
+            return
+        self._active_path = path
+        self._remaining = self.trace_steps
+        self.fired += 1
+        self.bus.publish("trace", action="started", path=path,
+                         reason=reason, ratio=ratio,
+                         steps=self.trace_steps)
+
+    def _stop(self) -> None:
+        path, self._active_path = self._active_path, None
+        self._cooldown_left = self.cooldown
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self._disabled = True
+            self.bus.publish("trace", action="error", path=path,
+                             reason=str(e)[:200])
+            return
+        self.bus.publish("trace", action="stopped", path=path)

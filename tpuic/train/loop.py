@@ -33,6 +33,7 @@ import numpy as np
 from tqdm import tqdm
 
 from tpuic.runtime import faults as _faults
+from tpuic.telemetry.events import publish as _tm_publish
 
 from tpuic.checkpoint.manager import CheckpointManager
 from tpuic.config import Config
@@ -208,6 +209,15 @@ class Trainer:
             if self.state_sharding is not None:
                 from tpuic.parallel.sharding import shard_state
                 self.state = shard_state(self.state, self.state_sharding)
+        # Telemetry (docs/observability.md): step-time breakdown, goodput
+        # accounting, optional JSONL event sink / trace trigger /
+        # TensorBoard bridge — all host-side subscribers on the global
+        # event bus (zero device syncs, zero compiles; test-asserted).
+        from tpuic import telemetry as _telemetry
+        self.telemetry = _telemetry.TrainTelemetry(
+            cfg.run, model_name=mcfg.name, image_size=d.resize_size,
+            global_batch=global_batch, n_devices=self.mesh.size,
+            device=jax.devices()[0], tb=self.logger.tb)
         # Non-finite rollback bookkeeping (docs/robustness.md): the jitted
         # step skips poisoned updates in-graph (train/step.py guard) and
         # counts the consecutive-skip streak in state.skip_count; the
@@ -217,6 +227,8 @@ class Trainer:
         self._rollback_pending = False
         self.rollbacks = 0
         self._quarantine_seen = 0
+        self._last_skip_streak = 0
+        self._steps_exhausted = False
 
     def _init_from_torch(self, path: str) -> None:
         """Pretrained-weight initialization from a torch checkpoint.
@@ -285,7 +297,14 @@ class Trainer:
         losses = AverageMeter()
         remaining = len(self.train_loader) - start_step
         self.last_epoch_steps = start_step
-        it = self.train_loader.epoch(epoch, start_step=start_step)
+        # Step-time breakdown (telemetry/steptime.py): the wrapped
+        # iterator times loader waits (data-wait), dispatch is timed
+        # around the step call below, and the residual is device time —
+        # pure perf_counter arithmetic, no host syncs added.
+        steptime = self.telemetry.steptime
+        steptime.epoch_start()
+        it = steptime.wrap_epoch(
+            self.train_loader.epoch(epoch, start_step=start_step))
         bar = tqdm(it, total=remaining, disable=not is_host0())
         metrics = None
         log_every = max(1, self.cfg.run.log_every_steps)
@@ -335,7 +354,14 @@ class Trainer:
                 # Poison this step's images host-side: same shapes/dtypes,
                 # so the guard's zero-recompile contract is what's tested.
                 fbatch["image"] = fbatch["image"] * np.float32("nan")
+            if _faults.fire("slow_step", step=step0 + step):
+                # Injected host-side stall (runtime/faults.py): a
+                # deterministic step-time regression, for trace-trigger
+                # tests — the step's work is untouched.
+                time.sleep(float(_faults.param("slow_step") or 0.05))
+            steptime.dispatch_start()
             self.state, metrics = self.train_step(self.state, fbatch)
+            steptime.dispatch_end()
             self.last_epoch_steps = start_step + step + 1
             if (step + 1) % log_every == 0:
                 handles = {"loss": metrics["loss"],
@@ -369,8 +395,29 @@ class Trainer:
                     # fit() for the restore now.
                     bar.close()
                     break
+            # Close the step's telemetry span (publishes the 'step'
+            # event with the data/dispatch/device breakdown). Sits after
+            # the deferred drain so blocking readbacks are charged to
+            # the step that performed them.
+            steptime.step_end(step0 + step + 1)
+            if (self.cfg.run.max_steps
+                    and step0 + step + 1 >= self.cfg.run.max_steps):
+                # --steps budget (smoke runs / CI telemetry gate): stop
+                # mid-epoch; fit() skips the epoch's val and exits.
+                self._steps_exhausted = True
+                bar.close()
+                break
         if pending is not None:
+            # Post-loop drain (break paths: budget/rollback/preemption —
+            # the in-loop last-step branch covers normal epoch ends): the
+            # blocking readback here is the final dispatched step still
+            # executing, i.e. device time AFTER its step event closed.
+            # Published as a 'drain' span so the goodput ledger books it
+            # as productive instead of losing it to 'other'.
+            t_drain = time.perf_counter()
             self._drain_train_log(pending, losses, bar, epoch)
+            _tm_publish("drain",
+                        duration_s=round(time.perf_counter() - t_drain, 3))
         # Epoch-mean loss over all steps, one sync, off the hot path: the
         # running meter only sees logged points (display semantics identical
         # to the reference bar, train.py:67-68).
@@ -388,6 +435,9 @@ class Trainer:
                         f"load(s) served a replacement (total {q})")
             self.logger.write(step0 + self.last_epoch_steps - start_step,
                               quarantined=delta, quarantined_total=q)
+        _tm_publish("epoch", epoch=epoch,
+                    steps=self.last_epoch_steps - start_step,
+                    loss=round(losses.avg, 6))
         return losses.avg
 
     def _drain_train_log(self, pending, losses: AverageMeter, bar,
@@ -408,6 +458,16 @@ class Trainer:
         streak = int(vals.get("skip_count", 0))
         if streak:
             extra["skipped_streak"] = streak
+            # 'skip' event (docs/observability.md): the streak at this
+            # drain plus the delta since the last one — the goodput
+            # tracker charges that many steps to the skip bucket. At
+            # log_every_steps=1 the delta is exact; at coarser cadences
+            # it undercounts streaks that reset inside an interval
+            # (documented estimate, same latency as rollback detection).
+            last = getattr(self, "_last_skip_streak", 0)
+            delta = streak - last if streak > last else streak
+            _tm_publish("skip", step=step_num, streak=streak, delta=delta)
+        self._last_skip_streak = streak
         self.logger.write(step_num, loss=loss,
                           accuracy=float(vals["accuracy"]),
                           lr=float(vals.get("lr", 0.0)),
@@ -426,6 +486,7 @@ class Trainer:
         """Reference val_epoch (train.py:78-97): exact global accuracy ×100,
         plus the exact global weighted val CE (num/den accumulated
         separately)."""
+        t_eval0 = time.perf_counter()
         correct = correct5 = count = loss_num = loss_den = 0.0
         have_top5 = False
         collect = self.cfg.run.collect_misclassified
@@ -515,6 +576,8 @@ class Trainer:
                     f"Val Loss {val_loss:.4f}")
         self.logger.write(int(jax.device_get(self.state.step)),
                           val_accuracy=score, val_loss=val_loss, **extra)
+        _tm_publish("eval", epoch=epoch, accuracy=round(score, 4),
+                    duration_s=round(time.perf_counter() - t_eval0, 3))
         return score
 
     # -- driver -------------------------------------------------------------
@@ -528,6 +591,7 @@ class Trainer:
         of the train step, the only recompile on any rollback path)."""
         self._rollback_pending = False
         self.rollbacks += 1
+        t_rb0 = time.perf_counter()
         run = self.cfg.run
         if self.rollbacks > run.max_rollbacks:
             raise RuntimeError(
@@ -585,6 +649,10 @@ class Trainer:
         host0_print(f"[rollback] restored '{self.ckpt.last_restore_rung}' — "
                     f"continuing at epoch {epoch} step {self.start_step} "
                     f"(rollback {self.rollbacks}/{run.max_rollbacks})")
+        self._last_skip_streak = 0
+        _tm_publish("rollback", epoch=epoch, rollback=self.rollbacks,
+                    rung=self.ckpt.last_restore_rung,
+                    duration_s=round(time.perf_counter() - t_rb0, 3))
         return epoch
 
     def fit(self, epochs: Optional[int] = None) -> float:
@@ -594,6 +662,9 @@ class Trainer:
         profiled = False
         if self.cfg.run.handle_preemption:
             self.preemption.install()
+        goodput = self.telemetry.goodput
+        goodput.start()
+        self._steps_exhausted = False
         try:
             epoch = self.start_epoch
             while epoch < epochs:
@@ -614,6 +685,14 @@ class Trainer:
                     epoch = self._do_rollback()
                     best = self.best_score
                     continue
+                if self._steps_exhausted:
+                    # --steps budget reached mid-epoch: a smoke run's
+                    # contract is N train steps + a goodput report, not
+                    # a val pass over an unfinished epoch.
+                    host0_print(f"[tpuic] step budget "
+                                f"({self.cfg.run.max_steps}) reached in "
+                                f"epoch {epoch}; stopping")
+                    break
                 # Epoch end is a common boundary: agree so a host whose
                 # local SIGTERM missed the last in-epoch sync point doesn't
                 # diverge from the others (val vs flush).
@@ -650,6 +729,12 @@ class Trainer:
                     best = score
                     self.ckpt.save_best(self.state, epoch, best)
                 self.ckpt.maybe_save_latest(self.state, epoch, best)
+                # Epoch-cadence goodput: one console line plus a
+                # 'goodput' event (TensorBoard fractions via the bus
+                # sink, JSONL via --metrics-jsonl).
+                host0_print(f"[goodput] {goodput.summary_line()}")
+                _tm_publish("goodput", step=self.telemetry.steptime.last_step,
+                            **goodput.report())
                 epoch += 1
         finally:
             self.preemption.uninstall()
@@ -658,5 +743,15 @@ class Trainer:
             # checkpoint in '{track}.new' (the restore ladder only reads
             # committed tracks).
             self.ckpt.wait()
+            if self.telemetry.tracer is not None:
+                self.telemetry.tracer.finish()
+            # Final goodput report — the run's wall-time ledger
+            # (productive/input/compile/checkpoint/skip/rollback/eval;
+            # CI asserts the buckets sum to ~100% of wall).
+            host0_print(f"[goodput] {goodput.summary_line()}")
+            _tm_publish("goodput", final=True,
+                        step=self.telemetry.steptime.last_step,
+                        **goodput.report())
+            self.telemetry.flush()
         self.best_score = best
         return best

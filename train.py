@@ -179,6 +179,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-quarantine", action="store_true",
                    help="fail fast on undecodable images instead of "
                         "serving a deterministic same-class replacement")
+    # Telemetry (tpuic/telemetry, docs/observability.md).
+    p.add_argument("--steps", type=int, default=0,
+                   help="stop after this many optimizer steps regardless "
+                        "of --epochs (0 = no cap; smoke runs and the CI "
+                        "telemetry gate use it)")
+    p.add_argument("--metrics-jsonl", default="",
+                   help="telemetry event JSONL sink: per-step time "
+                        "breakdown, skip/rollback/quarantine/checkpoint/"
+                        "compile events, and the final goodput report")
+    p.add_argument("--trace-dir", default="",
+                   help="triggered jax.profiler traces land here when a "
+                        "step regresses past --trace-threshold x the "
+                        "rolling median (TPUIC_TRACE=dir forces one "
+                        "immediate window)")
+    p.add_argument("--trace-threshold", type=float, default=3.0,
+                   help="step-time regression multiple that arms a trace "
+                        "(0 disables the automatic trigger)")
+    p.add_argument("--trace-steps", type=int, default=3,
+                   help="steps each triggered trace window covers")
     return p
 
 
@@ -233,7 +252,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       profile_dir=args.profile_dir, seed=args.seed,
                       skip_threshold=args.skip_threshold,
                       rollback=not args.no_rollback,
-                      rollback_rewarm_steps=args.rewarm_steps),
+                      rollback_rewarm_steps=args.rewarm_steps,
+                      max_steps=args.steps,
+                      metrics_jsonl=args.metrics_jsonl,
+                      trace_dir=args.trace_dir,
+                      trace_threshold=args.trace_threshold,
+                      trace_steps=args.trace_steps),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
     )
