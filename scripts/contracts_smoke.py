@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Runtime-contract smoke gate (ISSUE 4 acceptance; runs in tier-1 CI).
+
+The shared ``tpuic.analysis.runtime`` checkers (docs/analysis.md)
+applied to the REAL hot paths, in-process:
+
+- **train**: ``Trainer.train_epoch`` — epoch 0 warms up (compiles the
+  step), epoch 1 runs under ``assert_compiles_flat(0)`` +
+  ``bounded_device_gets`` with the deferred-drain budget (one batched
+  get per log interval plus the per-epoch step-counter read).  The
+  warmup epoch's device_get count is measured bare first, and the
+  checked epoch must MATCH it exactly: the checkers themselves add
+  zero host syncs (the PR-2/3 on-vs-off discipline).
+- **serve**: ``InferenceEngine`` AOT warmup over a real model, then a
+  mixed-size request stream covering every padding bucket under
+  ``assert_compiles_flat(0)``, cross-checked against the engine's own
+  executable-cache counters.
+
+Exit 0 on success; prints one summary line per contract.
+
+    python scripts/contracts_smoke.py [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def train_contract(work: str) -> None:
+    from tpuic.analysis import runtime as contracts
+    from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                              OptimConfig, RunConfig)
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.train.loop import Trainer
+
+    data = os.path.join(work, "data")
+    # 3 classes x 8 images / batch 4 = 6 steps/epoch, every batch full:
+    # fixed shapes, so epoch 1 must be compile-flat.
+    make_synthetic_imagefolder(data, classes=("a", "b", "c"),
+                               per_class=8, size=32)
+    cfg = Config(
+        data=DataConfig(data_dir=data, resize_size=32, batch_size=4,
+                        num_workers=2, shuffle_seed=0),
+        model=ModelConfig(name="resnet18-cifar", num_classes=0,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="adam", learning_rate=1e-3,
+                          class_weights=(), milestones=()),
+        run=RunConfig(epochs=2, ckpt_dir=os.path.join(work, "cp"),
+                      save_period=0, resume=False, log_every_steps=1),
+        mesh=MeshConfig(),
+    )
+    trainer = Trainer(cfg)
+    steps = trainer.train_loader.steps_per_epoch()
+
+    # Warmup epoch, bare: compiles the step, measures the drain budget.
+    with contracts.watch_compiles() as warm, \
+            contracts.count_device_gets() as bare:
+        trainer.train_epoch(0)
+    assert warm.compiles >= 1, "warmup epoch compiled nothing?"
+    # The deferred-drain discipline: one batched get per log interval
+    # (log_every_steps=1 -> one per step) + the per-epoch step-counter
+    # read.  A per-step readback regression would blow well past this.
+    budget = steps + 3
+    assert bare.count <= budget, \
+        f"warmup epoch used {bare.count} device_gets (budget {budget})"
+
+    # Steady-state epoch under the full checker stack.
+    with contracts.count_device_gets() as checked:
+        with contracts.assert_compiles_flat(what="train steady state"):
+            with contracts.bounded_device_gets(budget,
+                                               what="train steady state"):
+                trainer.train_epoch(1)
+    # Zero added host syncs from the checkers themselves.
+    assert checked.count == bare.count, \
+        f"checkers changed the sync count: {bare.count} bare vs " \
+        f"{checked.count} checked"
+    print(f"[contracts] train: {steps}-step epoch compile-flat, "
+          f"{checked.count} device_gets (budget {budget}), "
+          f"checkers added 0 syncs")
+
+
+def serve_contract() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuic.analysis import runtime as contracts
+    from tpuic.models import create_model
+    from tpuic.serve import InferenceEngine
+
+    model = create_model("resnet18-cifar", num_classes=3, dtype="float32")
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32),
+                           train=False)
+    buckets = (1, 2, 4)
+    eng = InferenceEngine(model, variables, image_size=32,
+                          buckets=buckets, max_wait_ms=0.0)
+    eng.warmup()
+    assert eng.stats.compiles == len(buckets)
+
+    rng = np.random.default_rng(0)
+    sizes = [1, 2, 3, 4] * 3  # covers every bucket, incl. padded dispatch
+    with contracts.assert_compiles_flat(what="serve steady state"):
+        futs = [eng.submit(rng.standard_normal(
+            (n, 32, 32, 3)).astype(np.float32)) for n in sizes]
+        for f in futs:
+            f.result(timeout=120)
+        eng.close()
+    s = eng.stats.snapshot()
+    assert s["compiles"] == len(buckets), "steady-state recompile"
+    assert s["executable_cache_hits"] == s["device_calls"]
+    print(f"[contracts] serve: {len(sizes)} requests over buckets "
+          f"{buckets} compile-flat, {s['device_calls']} device calls "
+          "all cache hits")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the temp workdir for inspection")
+    args = p.parse_args()
+    work = tempfile.mkdtemp(prefix="tpuic_contracts_")
+    try:
+        train_contract(work)
+        serve_contract()
+        print("[contracts] OK")
+        return 0
+    finally:
+        if args.keep:
+            print(f"workdir kept: {work}")
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

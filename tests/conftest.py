@@ -17,6 +17,10 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")  # silence AOT-cache noise
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Runtime contract checkers (docs/analysis.md): compile-flat marker +
+# compile_watch / device_gets fixtures for the whole suite.
+pytest_plugins = ("tpuic.analysis.pytest_plugin",)
+
 jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: model-sized CPU compiles dominate suite
 # time (minutes each); cache hits cut reruns to seconds. Keyed to the machine

@@ -1,0 +1,643 @@
+"""tpuic.analysis (ISSUE 4 acceptance): every lint rule with a paired
+bad fixture (detected) and good fixture (not flagged) — including the
+PR-2 cond+donation regression — plus suppression syntax, the baseline
+workflow, the CLI gate, and the runtime contract checkers (which must
+themselves add zero host syncs and zero compiles)."""
+
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.analysis import (Finding, Severity, RULES, fingerprint,
+                            lint_source, lint_paths, load_baseline,
+                            new_findings, write_baseline)
+from tpuic.analysis import runtime as contracts
+from tpuic.analysis.__main__ import main as lint_main
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, path="pkg/mod.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- paired good/bad fixtures, one per rule ----------------------------------
+HOT = "tpuic/train/loop.py"  # a hot-path module name for TPU101 fixtures
+
+CASES = [
+    # (rule, path, bad source, good source)
+    ("TPU101", HOT, """
+        import jax
+
+        def train_epoch(loader, state):
+            for batch in loader:
+                state, m = step(state, batch)
+                loss = jax.device_get(m["loss"])
+            return state
+        """, """
+        import jax
+
+        def train_epoch(loader, state):
+            pending = None
+            for batch in loader:
+                state, m = step(state, batch)
+                pending = m
+            return state
+
+        def _drain_train_log(pending):  # tpuic-ok: TPU101 the drain site
+            return jax.device_get(pending)
+        """),
+    ("TPU101", HOT, """
+        def train_epoch(metrics):
+            return metrics["loss"].item()
+        """, """
+        def setup(metrics):
+            return metrics["loss"].item()
+        """),  # .item() outside the hot loop functions is setup cost
+    ("TPU102", "pkg/mod.py", """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x * 2
+            return x
+        """, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            if n > 0:
+                return x * 2
+            return x
+        """),
+    ("TPU102", "pkg/mod.py", """
+        import jax
+
+        def g(x, k):
+            return x[:k]
+
+        def make():
+            return jax.jit(lambda x: x)
+
+        @jax.jit
+        def f(x, k):
+            while k > 0:
+                x, k = x * 2, k - 1
+            return x
+        """, """
+        import jax
+
+        @jax.jit
+        def f(x, mask):
+            if mask is not None:
+                x = x * mask
+            if x.shape[0] > 1:
+                x = x[:1]
+            return x
+        """),  # is-None and shape tests are static — never flagged
+    ("TPU103", "pkg/mod.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            name = f"value={x}"
+            return x
+        """, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("tag",))
+        def f(x, tag):
+            name = f"tag={tag} shape={x.shape}"
+            return x
+        """),
+    ("TPU201", "pkg/mod.py", """
+        import jax
+
+        def run(state, batch):
+            step = jax.jit(_step, donate_argnums=(0,))
+            new_state = step(state, batch)
+            check(state)  # read after donation
+            return new_state
+        """, """
+        import jax
+
+        def run(state, batch):
+            step = jax.jit(_step, donate_argnums=(0,))
+            state = step(state, batch)
+            check(state)  # rebound: this is the NEW buffer
+            return state
+        """),
+    # The PR-2 regression fixture: lax.cond inside a donated jit — the
+    # exact bisected cond+donation+compile-cache shape from
+    # tpuic/train/step.py (there: suppressed with the measured
+    # rationale; here: the linter must catch a re-introduction).
+    ("TPU202", "pkg/mod.py", """
+        import jax
+
+        def make_step(donate=True):
+            def train_step(state, batch):
+                ok = jnp.isfinite(batch["x"]).all()
+                state = jax.lax.cond(ok, _apply, _skip, state)
+                return state
+            return jax.jit(train_step,
+                           donate_argnums=(0,) if donate else ())
+        """, """
+        import jax
+        import jax.numpy as jnp
+
+        def make_step():
+            def train_step(state, batch):
+                ok = jnp.isfinite(batch["x"]).all()
+                updated = _apply(state)
+                state = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    updated, state)
+                return state
+            return jax.jit(train_step, donate_argnums=(0,))
+        """),  # the select IS the PR-2 fix: cond-free donated guard
+    ("TPU301", "pkg/mod.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float64)
+        """, """
+        import jax
+        import jax.numpy as jnp
+
+        def host_stats(x):
+            return np.float64(x.sum())
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float32)
+        """),
+    ("TPU302", "pkg/mod.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            scale = jnp.array([1.0, 2.0, 3.0])
+            return x * scale
+        """, """
+        import jax
+        import jax.numpy as jnp
+
+        _SCALE = jnp.array([1.0, 2.0, 3.0])
+
+        @jax.jit
+        def f(x):
+            return x * jnp.asarray(_SCALE)
+        """),
+    ("TPU401", "pkg/mod.py", """
+        import jax
+
+        def f(rng, shape):
+            a = jax.random.normal(rng, shape)
+            b = jax.random.uniform(rng, shape)  # same draws as a!
+            return a + b
+        """, """
+        import jax
+
+        def f(rng, shape):
+            ka, kb = jax.random.split(rng)
+            a = jax.random.normal(ka, shape)
+            b = jax.random.uniform(kb, shape)
+            return a + b
+        """),
+    ("TPU501", "pkg/mod.py", """
+        import os
+        import sys
+
+        def f():
+            return os.getpid()
+        """, """
+        import os
+
+        def f():
+            return os.getpid()
+        """),
+    ("TPU502", "pkg/mod.py", """
+        def f(x):
+            return x + 1
+            x = x * 2
+        """, """
+        def f(x):
+            if x > 0:
+                return x + 1
+            return x * 2
+        """),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,path,bad,good", CASES,
+    ids=[f"{c[0]}-{i}" for i, c in enumerate(CASES)])
+def test_rule_detects_bad_and_passes_good(rule, path, bad, good):
+    bad_rules = _rules_of(_lint(bad, path))
+    good_rules = _rules_of(_lint(good, path))
+    assert rule in bad_rules, f"{rule} missed its bad fixture"
+    assert rule not in good_rules, f"{rule} false-positived on its good " \
+                                   f"fixture"
+
+
+def test_every_rule_has_a_fixture_pair():
+    covered = {c[0] for c in CASES}
+    assert covered == set(RULES) - {"TPU000"}, \
+        f"rules without fixtures: {set(RULES) - covered - {'TPU000'}}"
+
+
+def test_findings_carry_severity_line_and_anchor():
+    fs = _lint("""
+        import os
+
+        def f():
+            return 1
+        """)
+    (f,) = fs
+    assert f.rule == "TPU501" and f.severity == Severity.WARNING
+    assert f.line == 2 and f.anchor == "import os"
+    assert "os" in f.render() and "TPU501" in f.render()
+
+
+def test_syntax_error_reported_not_raised():
+    fs = _lint("def f(:\n")
+    assert [f.rule for f in fs] == ["TPU000"]
+
+
+# -- jit-context detection ---------------------------------------------------
+def test_wrapped_by_name_far_from_def_is_jitted():
+    """The make_train_step idiom: the def and the jax.jit(name) wrap are
+    far apart — the def must still get the jit context."""
+    fs = _lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def make(cfg):
+            def step(state, batch):
+                c = jnp.array([1.0])
+                return state + batch * c
+            return jax.jit(step, donate_argnums=(0,))
+        """)
+    assert "TPU302" in _rules_of(fs)
+
+
+def test_nested_defs_inherit_jit_context():
+    fs = _lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            def inner(y):
+                return jnp.array([2.0]) * y
+            return inner(x)
+        """)
+    assert "TPU302" in _rules_of(fs)
+
+
+def test_plain_function_not_flagged_by_jit_rules():
+    fs = _lint("""
+        import jax.numpy as jnp
+
+        def host_helper(x, n):
+            if n > 0:
+                return jnp.array([1.0]) * x
+            return f"{x}"
+        """)
+    assert not _rules_of(fs) & {"TPU102", "TPU103", "TPU302"}
+
+
+# -- suppressions ------------------------------------------------------------
+def test_inline_suppression_with_reason_text():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:  # tpuic-ok: TPU102 n is enum-like, 2 traces max
+                return x * 2
+            return x
+        """
+    assert _rules_of(_lint(src)) == set()
+
+
+def test_suppression_is_rule_specific():
+    src = """
+        import os
+
+        def f(x):
+            return x  # tpuic-ok: TPU102 wrong rule id
+        """
+    assert "TPU501" in _rules_of(_lint(src))  # os still flagged
+
+
+def test_bare_suppression_silences_all_rules_on_line():
+    src = """
+        def f(x):
+            return x
+            x = 1  # tpuic-ok: unreachable kept as documentation
+        """
+    assert _rules_of(_lint(src)) == set()
+
+
+def test_rationale_before_id_suppresses_only_that_rule():
+    """'# tpuic-ok: words TPU102' must suppress TPU102, not silently
+    widen to every rule on the line (code-review regression)."""
+    src = """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:  # tpuic-ok: n is enum-like, see TPU102 catalog
+                return x * 2
+            return x
+        """
+    rules = _rules_of(_lint(src))
+    assert "TPU102" not in rules
+    assert "TPU501" in rules  # unused os: NOT silenced by that comment
+
+
+def test_def_line_allowlist_covers_scope_level_rules():
+    """TPU401/TPU201 are emitted by function-scope passes, not the
+    ctx-threaded walk — the def-line allowlist must still reach them
+    (code-review regression)."""
+    src = """
+        import jax
+
+        def paired(rng, shape):  # tpuic-ok: TPU401 deliberate same draws
+            a = jax.random.normal(rng, shape)
+            b = jax.random.uniform(rng, shape)
+            return a + b
+        """
+    assert _rules_of(_lint(src)) == set()
+    src2 = """
+        import jax
+
+        def run(state, batch):  # tpuic-ok: TPU201 aliasing probed on purpose
+            step = jax.jit(_step, donate_argnums=(0,))
+            new_state = step(state, batch)
+            check(state)
+            return new_state
+        """
+    assert _rules_of(_lint(src2)) == set()
+
+
+def test_def_line_allowlist_covers_whole_function():
+    src = """
+        import jax
+
+        def _drain_train_log(handles):  # tpuic-ok: TPU101 drain site
+            vals = jax.device_get(handles)
+            return float(vals["loss"])
+        """
+    assert _rules_of(_lint(src, HOT)) == set()
+
+
+# -- baseline workflow -------------------------------------------------------
+def _mk_finding(rule="TPU501", path="a.py", line=3,
+                anchor="import os"):
+    return Finding(rule, Severity.WARNING, path, line, "msg", anchor)
+
+
+def test_fingerprint_anchored_to_text_not_line_number():
+    a = _mk_finding(line=3)
+    b = _mk_finding(line=77)  # same offending text, file edited above it
+    assert fingerprint(a) == fingerprint(b)
+    assert fingerprint(a) != fingerprint(_mk_finding(anchor="import sys"))
+
+
+def test_fingerprint_invariant_to_invocation_path_style():
+    """CI lints `tpuic/` (relative); the CLI default is the absolute
+    repo path. Both must fingerprint a repo file identically, else a
+    committed baseline never matches in CI (code-review regression)."""
+    rel = _mk_finding(path="tpuic/train/loop.py")
+    abs_ = _mk_finding(path=os.path.join(_REPO, "tpuic/train/loop.py"))
+    assert fingerprint(rel) == fingerprint(abs_)
+
+
+def test_baseline_roundtrip_and_gating(tmp_path):
+    base = str(tmp_path / "baseline.json")
+    legacy = [_mk_finding(), _mk_finding(path="b.py", anchor="import re")]
+    write_baseline(base, legacy)
+    counts = load_baseline(base)
+    assert sum(counts.values()) == 2
+    # identical findings (even at moved lines): tolerated
+    fresh, stale = new_findings([_mk_finding(line=99),
+                                 _mk_finding(path="b.py", line=1,
+                                             anchor="import re")], counts)
+    assert fresh == [] and stale == 0
+    # a third, new finding: fails the gate
+    fresh, _ = new_findings(legacy + [_mk_finding(anchor="import json")],
+                            counts)
+    assert [f.anchor for f in fresh] == ["import json"]
+    # fixed debt: stale entries are counted (prune with --write-baseline)
+    fresh, stale = new_findings([], counts)
+    assert fresh == [] and stale == 2
+
+
+def test_duplicate_line_texts_gated_by_count(tmp_path):
+    base = str(tmp_path / "baseline.json")
+    two = [_mk_finding(line=3), _mk_finding(line=9)]  # same anchor text
+    write_baseline(base, two)
+    counts = load_baseline(base)
+    fresh, _ = new_findings(two, counts)
+    assert fresh == []
+    fresh, _ = new_findings(two + [_mk_finding(line=12)], counts)
+    assert len(fresh) == 1  # third copy exceeds the tolerated count
+
+
+# -- the CLI gate ------------------------------------------------------------
+BAD_MOD = """\
+import os
+import sys
+
+def f():
+    return os.getpid()
+"""
+
+
+def test_cli_gate_and_baseline_flow(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BAD_MOD)
+    base = str(tmp_path / "analysis_baseline.json")
+
+    # no baseline committed: the finding is new -> fail
+    assert lint_main([str(pkg), "--baseline", base]) == 1
+    out = capsys.readouterr().out
+    assert "TPU501" in out and "1 new finding(s)" in out
+
+    # accept current state, then the gate is green
+    assert lint_main([str(pkg), "--baseline", base,
+                      "--write-baseline"]) == 0
+    assert lint_main([str(pkg), "--baseline", base]) == 0
+
+    # a new violation on top of the baseline fails again
+    (pkg / "mod.py").write_text(BAD_MOD + "\n\ndef g():\n"
+                                "    return 1\n    dead = 2\n")
+    capsys.readouterr()
+    assert lint_main([str(pkg), "--baseline", base]) == 1
+    out = capsys.readouterr().out
+    assert "TPU502" in out and "TPU501" not in out  # legacy stays quiet
+
+    # fixing everything leaves stale entries: visible, green by default,
+    # red under --strict
+    (pkg / "mod.py").write_text("import os\n\ndef f():\n"
+                                "    return os.getpid()\n")
+    assert lint_main([str(pkg), "--baseline", base]) == 0
+    assert lint_main([str(pkg), "--baseline", base, "--strict"]) == 1
+
+
+def test_cli_json_and_select_and_list_rules(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BAD_MOD)
+    assert lint_main([str(pkg), "--no-baseline", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["rule"] == "TPU501"
+    assert lint_main([str(pkg), "--no-baseline",
+                      "--select", "TPU102"]) == 0  # only unused imports
+    assert lint_main(["--list-rules"]) == 0
+    assert "TPU202" in capsys.readouterr().out
+    assert lint_main([str(pkg), "--select", "NOPE"]) == 2
+
+
+def test_committed_tree_is_clean_against_committed_baseline():
+    """The acceptance criterion: `python -m tpuic.analysis tpuic/` exits
+    0 against the committed baseline — run in-process here so a PR that
+    introduces a footgun fails tier-1 even before the CI lint step."""
+    rc = lint_main([os.path.join(_REPO, "tpuic"),
+                    "--baseline",
+                    os.path.join(_REPO, "analysis_baseline.json")])
+    assert rc == 0
+
+
+# -- runtime contract checkers ----------------------------------------------
+def test_jit_cache_flat_passes_and_detects_retrace():
+    @jax.jit
+    def g(x):
+        return x + 1
+
+    g(jnp.ones((2,)))
+    with contracts.jit_cache_flat(g):
+        g(jnp.ones((2,)))  # cache hit: flat
+    assert contracts.jit_cache_size(g) == 1
+    with pytest.raises(AssertionError, match="retraced"):
+        with contracts.jit_cache_flat(g):
+            g(jnp.ones((3,)))  # new shape: retrace
+    with contracts.jit_cache_flat(g, max_new=1):
+        g(jnp.ones((4,)))  # explicit allowance
+    with pytest.raises(TypeError):
+        contracts.jit_cache_size(lambda x: x)
+
+
+def test_assert_compiles_flat_passes_warm_and_detects_compile():
+    f = jax.jit(lambda x: x - 2.0)
+    f(jnp.ones((4,))).block_until_ready()  # warmup
+    with contracts.assert_compiles_flat(what="warm replay"):
+        f(jnp.ones((4,))).block_until_ready()
+    with pytest.raises(AssertionError, match="compile counter not flat"):
+        with contracts.assert_compiles_flat():
+            # fresh function object: guaranteed in-process compile
+            jax.jit(lambda x: x * 1.5 - 0.25)(
+                jnp.ones((7,))).block_until_ready()
+
+
+def test_watch_compiles_counts_backend_compiles():
+    with contracts.watch_compiles() as w:
+        jax.jit(lambda x: x * 3.5 + 2.0)(jnp.ones((5,))).block_until_ready()
+    assert w.compiles >= 1
+    assert w.traces >= w.compiles
+
+
+def test_count_device_gets_and_budget():
+    x = jnp.ones((4,))
+    with contracts.count_device_gets() as c:
+        jax.device_get(x)
+        jax.device_get({"a": x, "b": x})  # one batched get, one count
+    assert c.count == 2
+    with pytest.raises(AssertionError, match="transfer budget"):
+        with contracts.bounded_device_gets(1, what="budget test"):
+            jax.device_get(x)
+            jax.device_get(x)
+
+
+def test_no_tracer_leaks_catches_leak():
+    stash = []
+
+    with pytest.raises(Exception):
+        with contracts.no_tracer_leaks():
+            @jax.jit
+            def f(x):
+                stash.append(x)  # the leak
+                return x * 2
+
+            f(jnp.ones((3,)))
+    stash.clear()
+
+
+def test_checkers_add_zero_syncs_and_zero_compiles():
+    """The PR-2/3 discipline applied to the checkers themselves: a mini
+    drain-pattern loop performs IDENTICAL device_get and compile counts
+    bare vs. nested inside the full checker stack."""
+    def loop():
+        @jax.jit
+        def step(s, x):
+            return s + x.sum()
+
+        s = jnp.zeros(())
+        for i in range(5):
+            s = step(s, jnp.ones((4,)) * i)
+            jax.device_get(s)  # the per-interval drain
+        return step
+
+    loop()  # prewarm jax's eager-op executables (jnp.ones, mul)
+    with contracts.watch_compiles() as w_bare, \
+            contracts.count_device_gets() as g_bare:
+        loop()
+    with contracts.watch_compiles() as w_checked, \
+            contracts.count_device_gets() as g_checked:
+        with contracts.assert_compiles_flat(max_new=1,
+                                            what="mini loop"):
+            with contracts.bounded_device_gets(5, what="mini loop"):
+                step = loop()
+    assert g_checked.count == g_bare.count == 5
+    assert w_checked.compiles == w_bare.compiles  # checkers compile nothing
+    assert contracts.jit_cache_size(step) == 1
+
+
+# Allowance covers the cold-process worst case (7: jnp.eye's eager ops +
+# the matmul warmup + the host conversion); what's under test is the
+# marker plumbing — assert_compiles_flat itself is pinned tight above.
+@pytest.mark.compiles_flat(max_new=8)
+def test_compiles_flat_marker_wraps_test():
+    f = jax.jit(lambda x: x @ x)
+    y = f(jnp.eye(3))
+    f(jnp.eye(3))
+    np.testing.assert_allclose(np.asarray(y), np.eye(3))
+
+
+def test_device_gets_fixture(device_gets):
+    jax.device_get(jnp.ones((2,)))
+    assert device_gets.count == 1
+
+
+def test_compile_watch_fixture(compile_watch):
+    jax.jit(lambda x: x + 0.125)(jnp.ones((6,))).block_until_ready()
+    assert compile_watch.compiles >= 1

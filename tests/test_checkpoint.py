@@ -8,6 +8,7 @@ from tpuic.config import ModelConfig, OptimConfig
 from tpuic.models import create_model
 from tpuic.train.optimizer import make_optimizer
 from tpuic.train.state import create_train_state
+from _gates import old_jax_lenient_restore
 
 OCFG = OptimConfig(optimizer="adam", learning_rate=1e-3, class_weights=(),
                    milestones=())
@@ -229,6 +230,7 @@ def test_legacy_checkpoint_without_step_key_keeps_fast_path(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+@old_jax_lenient_restore
 def test_mid_epoch_checkpoint_degraded_restore_replays_epoch(tmp_path):
     """A mid-epoch flush restored through the DEGRADED (lenient) path —
     here: into a different architecture, partial param match — must

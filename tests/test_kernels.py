@@ -11,6 +11,7 @@ import pytest
 
 from tpuic.kernels import flash_attention, fused_weighted_cross_entropy
 from tpuic.train.loss import weighted_cross_entropy
+from _gates import requires_shard_map
 
 
 def _rand(key, shape):
@@ -51,6 +52,7 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    @requires_shard_map
     def test_gradients_match_dense_sharded(self, devices8):
         """Backward kernels under shard_map over the data axis."""
         from tpuic.config import MeshConfig
@@ -301,6 +303,7 @@ class TestKernelWiring:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
 
+    @requires_shard_map
     def test_sharded_train_step_with_flash_and_fused_loss(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -20,6 +20,7 @@ from tpuic.runtime.mesh import make_mesh
 from tpuic.train.optimizer import make_optimizer
 from tpuic.train.state import create_train_state
 from tpuic.train.step import make_train_step
+from _gates import old_jax_moe_numerics
 
 MCFG = ModelConfig(name="vit-tiny-moe", num_classes=3, dtype="float32")
 OCFG = OptimConfig(optimizer="sgd", learning_rate=0.01, class_weights=(),
@@ -36,6 +37,7 @@ def _layer_apply(capacity_factor, x, seed=0, mask=None):
     return y, float(switch_aux_loss(probs, onehot, mask))
 
 
+@old_jax_moe_numerics
 def test_moe_layer_shapes_and_aux():
     x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 16)),
                     jnp.float32)

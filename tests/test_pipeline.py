@@ -13,6 +13,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuic.parallel.pipeline import pipeline_apply, stack_stage_params
+from _gates import requires_shard_map
 
 
 def _stage_fn(params, x):
@@ -53,6 +54,7 @@ def setup(stage_mesh):
     return stacked, x
 
 
+@requires_shard_map
 def test_pipeline_forward_matches_sequential(setup, stage_mesh):
     stacked, x = setup
     got = pipeline_apply(lambda p, mb: jax.vmap(
@@ -69,6 +71,7 @@ def test_pipeline_params_actually_sharded(setup):
         assert not leaf.sharding.is_fully_replicated
 
 
+@requires_shard_map
 def test_pipeline_gradients_match_sequential(setup, stage_mesh):
     """jax.grad differentiates the pipelined schedule directly — the
     backward pipeline falls out of the forward program."""
@@ -90,6 +93,7 @@ def test_pipeline_gradients_match_sequential(setup, stage_mesh):
                                    rtol=5e-5, atol=1e-5)
 
 
+@requires_shard_map
 def test_pipeline_composes_with_data_parallel(devices8):
     """DP x PP on a ('data','stage') mesh: x sharded over 'data' on the
     microbatch dim via x_spec; same numbers as sequential."""
@@ -110,6 +114,7 @@ def test_pipeline_composes_with_data_parallel(devices8):
         pipeline_apply(fn, stacked, x, mesh, x_spec=P("stage"))
 
 
+@requires_shard_map
 def test_pipeline_of_real_encoder_blocks(stage_mesh):
     """4 real ViT EncoderBlocks pipelined over 4 stages == the same blocks
     applied sequentially — transformer PP, not a toy stage."""
@@ -140,6 +145,7 @@ def test_pipeline_of_real_encoder_blocks(stage_mesh):
                                rtol=2e-5, atol=2e-6)
 
 
+@requires_shard_map
 def test_pipeline_trains_end_to_end(stage_mesh):
     """PP carries full training: optimizer updates through the pipelined
     loss reduce it — stages stay sharded the whole time."""
@@ -175,6 +181,7 @@ def test_pipeline_trains_end_to_end(stage_mesh):
         assert leaf.sharding.spec[0] == "stage"
 
 
+@requires_shard_map
 def test_pipeline_microbatch_count_independence(setup, stage_mesh):
     """More microbatches = same math (GPipe's schedule is a pure
     reordering)."""

@@ -12,6 +12,7 @@ import pytest
 from tpuic.config import MeshConfig
 from tpuic.parallel import ring_attention, ring_flash_attention
 from tpuic.runtime.mesh import make_mesh
+from _gates import requires_shard_map
 
 
 def _dense(q, k, v):
@@ -27,6 +28,7 @@ def _rand(key, shape, dtype=jnp.float32):
 
 class TestRingAttention:
     # 197 = ViT-B/16 tokens: exercises padding (197 % 4 != 0)
+    @requires_shard_map
     @pytest.mark.parametrize("n", [32, 197])
     def test_matches_dense(self, devices8, n):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
@@ -36,6 +38,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
                                    rtol=1e-5, atol=1e-5)
 
+    @requires_shard_map
     def test_full_ring_no_batch_axis(self, devices8):
         mesh = make_mesh(MeshConfig(data=1, seq=8), devices8)
         q, k, v = (_rand(i + 5, (2, 64, 2, 8)) for i in range(3))
@@ -43,6 +46,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
                                    rtol=1e-5, atol=1e-5)
 
+    @requires_shard_map
     def test_gradients_match_dense(self, devices8):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
         q, k, v = (_rand(i + 9, (2, 24, 2, 8)) for i in range(3))
@@ -53,6 +57,7 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    @requires_shard_map
     def test_seq_axis_size_one_falls_back(self, devices8):
         mesh = make_mesh(MeshConfig(data=8, seq=1), devices8)
         q, k, v = (_rand(i, (8, 16, 2, 8)) for i in range(3))
@@ -67,6 +72,7 @@ class TestRingAttention:
         with pytest.raises(ValueError, match="no 'seq' axis"):
             ring_attention(q, q, q, mesh)
 
+    @requires_shard_map
     def test_bf16(self, devices8):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
         q, k, v = (_rand(i, (2, 32, 2, 8), jnp.bfloat16) for i in range(3))
@@ -87,6 +93,7 @@ class TestRingFlashAttention:
     # 5: the 4th ring block is ENTIRELY padding — exercises the kernels'
     # masked_sentinel (-inf lse) so the block weighs zero in the
     # cross-block logsumexp combination.
+    @requires_shard_map
     @pytest.mark.parametrize("n", [16, 10, 5])
     def test_matches_dense_fwd_and_bwd(self, devices8, n):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
@@ -103,6 +110,7 @@ class TestRingFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    @requires_shard_map
     @pytest.mark.parametrize("n", [16, 5])  # 5: fully-padded ring block
     def test_packed_kernel_path_matches_dense(self, devices8, n):
         """head_dim 64 / even heads routes each ring step through the
@@ -133,6 +141,7 @@ class TestRingFlashAttention:
         with pytest.raises(ValueError, match="no 'seq' axis"):
             ring_flash_attention(q, q, q, mesh)
 
+    @requires_shard_map
     def test_composes_with_head_sharding(self, devices8):
         """SP x TP: heads sharded over 'model' while the ring runs over
         'seq' — each shard's flash kernel sees H/tp local heads."""
@@ -144,6 +153,7 @@ class TestRingFlashAttention:
                                    np.asarray(_dense(q, k, v)),
                                    rtol=1e-4, atol=1e-4)
 
+    @requires_shard_map
     def test_ring_flash_vit_matches_dense_vit(self, devices8):
         from tpuic.models import create_model
 
@@ -162,6 +172,7 @@ class TestRingFlashAttention:
 
 
 class TestRingViT:
+    @requires_shard_map
     def test_ring_vit_matches_dense_vit(self, devices8):
         from tpuic.models import create_model
 

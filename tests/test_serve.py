@@ -133,15 +133,21 @@ def test_backpressure_bounded_queue():
 def test_compile_counter_flat_after_warmup():
     """The acceptance contract: warmup compiles once per bucket; a request
     stream covering EVERY size 1..max_batch adds zero compiles — each
-    device call is an executable-cache hit."""
+    device call is an executable-cache hit.  Asserted BOTH by the
+    engine's own counters and at the XLA layer via the shared
+    tpuic.analysis.runtime checker (no backend_compile events in steady
+    state — docs/analysis.md)."""
+    from tpuic.analysis import runtime as contracts
+
     eng = _engine(max_wait_ms=0.0)
     timings = eng.warmup()
     assert eng.stats.compiles == 4 == len(timings)
     rng = np.random.default_rng(5)
-    futs = [eng.submit(_imgs(rng, n)) for n in list(range(1, 9)) * 3]
-    for f in futs:
-        f.result(timeout=60)
-    eng.close()
+    with contracts.assert_compiles_flat(what="serve steady state"):
+        futs = [eng.submit(_imgs(rng, n)) for n in list(range(1, 9)) * 3]
+        for f in futs:
+            f.result(timeout=60)
+        eng.close()
     s = eng.stats.snapshot()
     assert s["compiles"] == 4  # flat: zero steady-state recompiles
     assert s["executable_cache_hits"] == s["device_calls"]

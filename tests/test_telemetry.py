@@ -200,7 +200,11 @@ def test_mfu_math_matches_bench_golden():
 # -- the PR-2 discipline: no new syncs, no new compiles ----------------------
 def _mini_loop(n_steps, telemetry, jsonl_path=None):
     """A miniature of train_epoch's drain pattern around a jitted step:
-    returns (jitted step, device_get call count)."""
+    returns (jitted step, device_get call count).  Transfer counting
+    rides the shared tpuic.analysis.runtime checker instead of a local
+    jax.device_get monkeypatch (docs/analysis.md)."""
+    from tpuic.analysis import runtime as contracts
+
     bus = EventBus()
     closers = []
     if telemetry:
@@ -217,52 +221,46 @@ def _mini_loop(n_steps, telemetry, jsonl_path=None):
         s = s + x.sum()
         return s, {"loss": s}
 
-    gets = {"n": 0}
-    real_get = jax.device_get
-
-    def counting_get(tree):
-        gets["n"] += 1
-        return real_get(tree)
-
-    jax.device_get = counting_get
     try:
-        state = jnp.zeros(())
-        if timer:
-            timer.epoch_start()
+        with contracts.count_device_gets() as gets:
+            state = jnp.zeros(())
+            if timer:
+                timer.epoch_start()
 
-        def loader():
-            for i in range(n_steps):
-                yield jnp.ones((4,)) * i
-        it = timer.wrap_epoch(loader()) if timer else loader()
-        for i, batch in enumerate(it):
-            if timer:
-                timer.dispatch_start()
-            state, m = step(state, batch)
-            if timer:
-                timer.dispatch_end()
-            # the loop's ONE deferred readback per log interval
-            jax.device_get({"loss": m["loss"]})
-            if timer:
-                timer.step_end(i + 1)
+            def loader():
+                for i in range(n_steps):
+                    yield jnp.ones((4,)) * i
+            it = timer.wrap_epoch(loader()) if timer else loader()
+            for i, batch in enumerate(it):
+                if timer:
+                    timer.dispatch_start()
+                state, m = step(state, batch)
+                if timer:
+                    timer.dispatch_end()
+                # the loop's ONE deferred readback per log interval
+                jax.device_get({"loss": m["loss"]})
+                if timer:
+                    timer.step_end(i + 1)
     finally:
-        jax.device_get = real_get
         for c in closers:
             c()
-    return step, gets["n"]
+    return step, gets.count
 
 
 def test_compile_counter_and_host_syncs_flat_with_telemetry(tmp_path):
     """The acceptance contract: per-step host-sync count and the compile
     counter are IDENTICAL with telemetry on vs. off — telemetry is
     perf_counter arithmetic plus host-side event plumbing, nothing else."""
+    from tpuic.analysis import runtime as contracts
+
     step_off, gets_off = _mini_loop(6, telemetry=False)
     step_on, gets_on = _mini_loop(6, telemetry=True,
                                   jsonl_path=str(tmp_path / "ev.jsonl"))
     assert gets_on == gets_off == 6
     # zero extra compiles: one executable each, no telemetry-induced
     # retrace (same assertion style as the PR-2 skip-guard contract)
-    assert step_off._cache_size() == 1
-    assert step_on._cache_size() == 1
+    assert contracts.jit_cache_size(step_off) == 1
+    assert contracts.jit_cache_size(step_on) == 1
     # and the JSONL sink recorded a breakdown for every step
     recs = [json.loads(ln) for ln in open(str(tmp_path / "ev.jsonl"))]
     steps = [r for r in recs if r["event"] == "step"]

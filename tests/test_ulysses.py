@@ -11,6 +11,7 @@ import pytest
 from tpuic.config import MeshConfig
 from tpuic.parallel import ulysses_attention
 from tpuic.runtime.mesh import make_mesh
+from _gates import requires_shard_map
 
 
 def _dense(q, k, v):
@@ -26,6 +27,7 @@ def _rand(key, shape):
 
 class TestUlysses:
     # 197 = ViT-B/16 tokens: exercises padding (197 % 4 != 0); H=4 = seq size
+    @requires_shard_map
     @pytest.mark.parametrize("n", [32, 197])
     def test_matches_dense(self, devices8, n):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
@@ -34,6 +36,7 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
                                    rtol=1e-5, atol=1e-5)
 
+    @requires_shard_map
     def test_gradients_match_dense(self, devices8):
         mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
         q, k, v = (_rand(i + 9, (2, 24, 4, 8)) for i in range(3))
@@ -46,6 +49,7 @@ class TestUlysses:
 
     # 24: padded (24 % 4 == 0 but kernel pads to 128); 10: caller padding
     # (10 % 4 != 0 -> ulysses pads to 12, flash masks via valid_len).
+    @requires_shard_map
     @pytest.mark.parametrize("n", [24, 10])
     def test_flash_local_matches_dense_fwd_and_bwd(self, devices8, n):
         """attention='ulysses-flash': the head-sharded local attention runs
@@ -71,6 +75,7 @@ class TestUlysses:
         with pytest.raises(ValueError, match="heads % seq axis"):
             ulysses_attention(q, q, q, mesh)
 
+    @requires_shard_map
     def test_seq_axis_size_one_falls_back(self, devices8):
         mesh = make_mesh(MeshConfig(data=8, seq=1), devices8)
         q, k, v = (_rand(i, (8, 16, 2, 8)) for i in range(3))
@@ -78,6 +83,7 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(got), np.asarray(_dense(q, k, v)),
                                    rtol=1e-5, atol=1e-5)
 
+    @requires_shard_map
     def test_matches_ring(self, devices8):
         """Both SP strategies compute the same function."""
         from tpuic.parallel import ring_attention
@@ -91,6 +97,7 @@ class TestUlysses:
 
 
 class TestUlyssesViT:
+    @requires_shard_map
     @pytest.mark.parametrize("impl", ["ulysses", "ulysses-flash"])
     def test_ulysses_vit_matches_dense_vit(self, devices8, impl):
         from tpuic.models import create_model
@@ -112,6 +119,7 @@ class TestUlyssesViT:
 
 
 class TestUlyssesWithTP:
+    @requires_shard_map
     def test_head_sharded_under_model_axis(self, devices8):
         """TP composition: heads stay sharded over 'model' — the all-to-all
         redistributes only each TP rank's local heads (ADVICE r1: ulysses

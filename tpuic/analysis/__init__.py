@@ -1,0 +1,33 @@
+"""Static + runtime guard rails for the JAX/TPU footguns this repo keeps
+paying for (docs/analysis.md).
+
+Two halves, one discipline:
+
+- **Linter** (``python -m tpuic.analysis tpuic/``): AST rules for the
+  hazard classes PRs 1-3 each debugged by hand — host syncs in hot-path
+  modules, recompile hazards inside jitted functions, donation misuse
+  (including the bisected cond+donation+compile-cache corruption),
+  accidental float64 promotion, PRNG-key reuse — plus the generic
+  hygiene rules (unused imports, dead code) that keep the tree clean.
+  Findings are gated against a committed baseline
+  (``analysis_baseline.json``): new violations fail CI, legacy ones are
+  visible suppressions.
+- **Runtime contract checkers** (``tpuic.analysis.runtime``): context
+  managers + pytest fixtures asserting compile-count flatness after
+  warmup, bounded device-transfer counts, and tracer-leak freedom over a
+  block — the one shared home for the compile-counter asserts
+  test_serve/test_faults/test_telemetry used to copy-paste, also run by
+  the train/serve smoke scripts.
+"""
+
+from tpuic.analysis.core import (Finding, Severity, collect_files,
+                                 lint_paths, lint_source)
+from tpuic.analysis.rules import RULES, Rule
+from tpuic.analysis.baseline import (fingerprint, load_baseline,
+                                     new_findings, write_baseline)
+
+__all__ = [
+    "Finding", "Severity", "Rule", "RULES",
+    "collect_files", "lint_paths", "lint_source",
+    "fingerprint", "load_baseline", "new_findings", "write_baseline",
+]

@@ -1,0 +1,112 @@
+"""``python -m tpuic.analysis [paths...]`` — the JAX/TPU footgun linter.
+
+Exit codes: 0 = clean against the baseline, 1 = new findings (or, with
+``--strict``, stale baseline entries), 2 = usage error.
+
+    python -m tpuic.analysis tpuic/                 # gate vs baseline
+    python -m tpuic.analysis tpuic/ --no-baseline   # every finding
+    python -m tpuic.analysis tpuic/ --write-baseline  # accept current
+    python -m tpuic.analysis --list-rules           # the catalog
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tpuic.analysis.baseline import (load_baseline, new_findings,
+                                     write_baseline)
+from tpuic.analysis.core import Finding, lint_paths
+from tpuic.analysis.rules import RULES
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(_REPO, "analysis_baseline.json")
+
+
+def _print_findings(findings: List[Finding], as_json: bool) -> None:
+    if as_json:
+        print(json.dumps([{
+            "rule": f.rule, "severity": str(f.severity), "path": f.path,
+            "line": f.line, "message": f.message, "anchor": f.anchor,
+        } for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tpuic.analysis",
+                                description=__doc__)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: tpuic/)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON path (default: "
+                        "analysis_baseline.json at the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding; exit 1 if any")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept the current findings as the baseline")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--exclude", default="",
+                   help="comma-separated path substrings to skip")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{r.id}  {r.name:<24} [{r.severity}]\n    {r.doc}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "tpuic")]
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    if select:
+        unknown = [s for s in select if s not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+    exclude = [e.strip() for e in args.exclude.split(",") if e.strip()]
+    findings, files = lint_paths(paths, exclude=exclude, select=select)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"baseline: {len(findings)} finding(s) across "
+              f"{len(files)} file(s) written to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        _print_findings(findings, args.as_json)
+        if not args.as_json:
+            print(f"{len(findings)} finding(s) in {len(files)} file(s)")
+        return 1 if findings else 0
+
+    baseline = load_baseline(args.baseline)
+    fresh, stale = new_findings(findings, baseline)
+    _print_findings(fresh, args.as_json)
+    if not args.as_json:
+        tag = "" if os.path.exists(args.baseline) else " (no baseline file)"
+        print(f"{len(fresh)} new finding(s) vs baseline{tag}; "
+              f"{len(findings)} total in {len(files)} file(s); "
+              f"{stale} stale baseline entr(y/ies)")
+        if stale and not args.strict:
+            print("  (stale entries are fixed debt — refresh with "
+                  "--write-baseline)")
+    if fresh:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

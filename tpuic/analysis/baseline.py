@@ -1,0 +1,110 @@
+"""Committed-baseline workflow: new findings fail, legacy ones are
+visible debt.
+
+The baseline (``analysis_baseline.json`` at the repo root) is a list of
+fingerprinted findings the tree is *allowed* to contain.  A fingerprint
+is ``sha1(rule | normalized-path | stripped-source-line)`` — anchored to
+the offending line's *text*, not its number, so edits elsewhere in the
+file don't churn it.  Identical lines in one file (rare) are handled by
+count: the baseline stores how many of each fingerprint it tolerates,
+and the gate fails only when the live tree exceeds that count.
+
+Workflow (docs/analysis.md):
+
+- fix a legacy finding        -> the stale entry is reported (and
+                                 ``--write-baseline`` prunes it)
+- introduce a new finding     -> CI fails with the finding rendered
+- genuinely intended          -> suppress inline (``# tpuic-ok: RULE
+                                 why``) — preferred, the reason lives
+                                 next to the code — or re-baseline
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from tpuic.analysis.core import Finding
+
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _norm_path(path: str) -> str:
+    """Repo-root-relative when the file lives under the repo, else the
+    absolute path.  Critical for the fingerprint: the CI invocation
+    (``tpuic/``, relative) and the CLI default (absolute) must hash a
+    file identically, on any checkout location."""
+    p = os.path.normpath(os.path.abspath(path))
+    try:
+        rel = os.path.relpath(p, _ROOT)
+    except ValueError:  # Windows: different drive
+        rel = ".."
+    if not rel.startswith(".."):
+        p = rel
+    return p.replace("\\", "/")
+
+
+def fingerprint(f: Finding) -> str:
+    key = f"{f.rule}|{_norm_path(f.path)}|{f.anchor}"
+    return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """{fingerprint: tolerated count}; {} when the file doesn't exist."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts: Dict[str, int] = collections.Counter()
+    for entry in data.get("findings", []):
+        counts[entry["fingerprint"]] += int(entry.get("count", 1))
+    return dict(counts)
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Write the current findings as the new tolerated baseline, grouped
+    and human-diffable (sorted by path/rule, one entry per fingerprint)."""
+    grouped: Dict[str, List[Finding]] = collections.defaultdict(list)
+    for f in findings:
+        grouped[fingerprint(f)].append(f)
+    entries = []
+    for fp, group in grouped.items():
+        f = group[0]
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": _norm_path(f.path),
+            "line": f.line,        # informational; not part of the key
+            "anchor": f.anchor,
+            "message": f.message,
+            "count": len(group),
+        })
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def new_findings(findings: Sequence[Finding], baseline: Dict[str, int]
+                 ) -> Tuple[List[Finding], int]:
+    """(findings beyond what the baseline tolerates, stale entry count).
+
+    Stale = baseline entries the live tree no longer produces; reported
+    so fixed debt gets pruned instead of silently shielding a future
+    regression on the same line text.
+    """
+    remaining = dict(baseline)
+    fresh: List[Finding] = []
+    for f in findings:
+        fp = fingerprint(f)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            fresh.append(f)
+    stale = sum(1 for v in remaining.values() if v > 0)
+    return fresh, stale

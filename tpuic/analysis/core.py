@@ -1,0 +1,152 @@
+"""Linter core: findings, suppression parsing, file walking, the driver.
+
+A finding is (rule, severity, path, line, message) plus the stripped
+source line it anchors to — the anchor text (not the line *number*) is
+what the baseline fingerprints, so unrelated edits above a legacy
+finding don't churn the baseline.
+
+Suppression syntax (docs/analysis.md): an inline comment on the
+offending line
+
+    jax.device_get(handles)  # tpuic-ok: TPU101 deferred drain site
+
+silences the named rule(s) for that line; multiple IDs separate with
+commas (``# tpuic-ok: TPU101, TPU501 reason...``).  A bare
+``# tpuic-ok:`` with no rule ID silences every rule on the line (use
+sparingly — reviewers grep for these).  Suppressions are the
+*allowlist* mechanism the host-sync rule's "deferred-drain sites" refer
+to: the sync is intentional, the comment says why, and the linter keeps
+every other line honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # 'error', not 'Severity.ERROR'
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str            # e.g. 'TPU101'
+    severity: Severity
+    path: str            # as given to the linter (relative in CI)
+    line: int            # 1-based
+    message: str
+    anchor: str = ""     # stripped source text of the offending line
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+_SUPPRESS_RE = re.compile(r"#\s*tpuic-ok:\s*(.*)")
+_RULE_ID_RE = re.compile(r"TPU\d+")
+
+
+def suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """{line: set of suppressed rule IDs, or None for 'all rules'}.
+
+    Parsed from real COMMENT tokens, so a ``tpuic-ok`` inside a string
+    literal doesn't silence anything.  Any ``TPU###`` ID anywhere after
+    the colon names a suppressed rule (so rationale text before the ID
+    still suppresses only that rule, never everything); a comment with
+    no ID at all is the deliberate suppress-all form.
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        lines = iter(source.splitlines(keepends=True))
+        tokens = tokenize.generate_tokens(lambda: next(lines))
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = set(_RULE_ID_RE.findall(m.group(1)))
+            out[tok.start[0]] = ids or None
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def is_suppressed(finding: Finding,
+                  supp: Dict[int, Optional[Set[str]]]) -> bool:
+    ids = supp.get(finding.line, "absent")
+    if ids == "absent":
+        return False
+    return ids is None or finding.rule in ids
+
+
+def collect_files(paths: Sequence[str],
+                  exclude: Sequence[str] = ()) -> List[str]:
+    """Every .py file under the given files/directories, sorted; paths in
+    ``exclude`` (substring match on the relative path) are dropped."""
+    out: Set[str] = set()
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.add(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            ".jax_cache")]
+                for f in files:
+                    if f.endswith(".py"):
+                        out.add(os.path.join(dirpath, f))
+    return sorted(f for f in out
+                  if not any(e and e in f for e in exclude))
+
+
+def lint_source(source: str, path: str,
+                select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings sorted by
+    (line, rule).  ``select`` restricts to those rule IDs."""
+    import ast
+
+    from tpuic.analysis.rules import run_rules
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("TPU000", Severity.ERROR, path, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    src_lines = source.splitlines()
+
+    def anchored(f: Finding) -> Finding:
+        text = (src_lines[f.line - 1].strip()
+                if 0 < f.line <= len(src_lines) else "")
+        return dataclasses.replace(f, anchor=text)
+
+    supp = suppressions(source)
+    findings = [anchored(f)
+                for f in run_rules(tree, path, source, supp=supp)]
+    if select is not None:
+        chosen = set(select)
+        findings = [f for f in findings if f.rule in chosen]
+    findings = [f for f in findings if not is_suppressed(f, supp)]
+    return sorted(findings, key=lambda f: (f.line, f.rule))
+
+
+def lint_paths(paths: Sequence[str], exclude: Sequence[str] = (),
+               select: Optional[Iterable[str]] = None
+               ) -> Tuple[List[Finding], List[str]]:
+    """Lint every file under ``paths``; returns (findings, files)."""
+    files = collect_files(paths, exclude)
+    findings: List[Finding] = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), f, select=select))
+    return findings, files

@@ -1,0 +1,54 @@
+"""Pytest plugin for the runtime contract checkers (docs/analysis.md).
+
+Registered from tests/conftest.py via ``pytest_plugins``; provides the
+checkers as fixtures plus a ``compiles_flat`` marker that wraps a whole
+test in the steady-state assertion:
+
+    @pytest.mark.compiles_flat(max_new=4)   # warmup allowance
+    def test_my_stream(...): ...
+
+    def test_drain_budget(device_gets):
+        ...
+        assert device_gets.count <= 2
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuic.analysis import runtime
+
+
+def pytest_configure(config) -> None:
+    config.addinivalue_line(
+        "markers",
+        "compiles_flat(max_new=0): assert at most max_new new XLA "
+        "executables are built during the test "
+        "(tpuic.analysis.runtime.assert_compiles_flat)")
+
+
+@pytest.fixture(autouse=True)
+def _compiles_flat_marker(request):
+    """Honors ``@pytest.mark.compiles_flat`` — no-op without the mark."""
+    m = request.node.get_closest_marker("compiles_flat")
+    if m is None:
+        yield
+        return
+    max_new = m.kwargs.get("max_new", m.args[0] if m.args else 0)
+    with runtime.assert_compiles_flat(max_new=max_new,
+                                      what=request.node.name):
+        yield
+
+
+@pytest.fixture
+def compile_watch():
+    """Observe compile/trace deltas over the test (no assertion)."""
+    with runtime.watch_compiles() as w:
+        yield w
+
+
+@pytest.fixture
+def device_gets():
+    """Count jax.device_get calls over the test (no assertion)."""
+    with runtime.count_device_gets() as c:
+        yield c

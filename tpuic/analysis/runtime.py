@@ -1,0 +1,213 @@
+"""Runtime contract checkers — the dynamic half of tpuic.analysis.
+
+What the linter can't see statically, these assert at runtime, with one
+shared API instead of the compile-counter monkeypatching test_serve /
+test_faults / test_telemetry used to each reinvent (docs/analysis.md):
+
+- ``watch_compiles()`` / ``assert_compiles_flat()``: XLA compile
+  counting via a process-wide ``jax.monitoring`` listener.  The
+  steady-state contract from PR 1-3: after warmup, a request stream or
+  train loop performs ZERO further backend compiles.
+- ``jit_cache_size(fn)`` / ``jit_cache_flat(*fns)``: per-function
+  executable-cache flatness (the PR-2 skip-guard assertion style — one
+  compiled program across skip and apply paths).
+- ``count_device_gets()`` / ``bounded_device_gets(n)``: device->host
+  transfer counting (the deferred-drain discipline: one batched get per
+  log interval, nothing per step).
+- ``no_tracer_leaks()``: ``jax.check_tracer_leaks`` over a block.
+
+Every checker is host-side arithmetic over events jax already emits:
+enabling them adds zero device syncs and zero compiles (asserted by
+tests/test_analysis.py with the checkers nested inside each other —
+the same on-vs-off discipline PR 2/3 applied to their own features).
+
+All helpers import jax lazily so ``python -m tpuic.analysis`` (the
+linter) stays importable and fast in environments without jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterator, Optional
+
+# jax.monitoring key suffixes (jax 0.4.x): one trio per compilation —
+# jaxpr_trace / jaxpr_to_mlir_module / backend_compile.  Retraces that
+# hit the executable cache emit a lone jaxpr_trace, so backend_compile
+# is THE "new executable built" signal.
+_COMPILE_PREFIX = "/jax/core/compile/"
+BACKEND_COMPILE = "backend_compile_duration"
+JAXPR_TRACE = "jaxpr_trace_duration"
+
+
+class _CompileMonitor:
+    """Process-wide monotonic counters over jax.monitoring compile
+    events.  jax has no listener unregister, so this installs exactly
+    once and contexts snapshot/diff the counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self.counts: Dict[str, int] = {}
+
+    def install(self) -> bool:
+        with self._lock:
+            if self._installed:
+                return True
+            try:
+                from jax import monitoring as _jm
+            except Exception:
+                return False
+
+            def _listener(key: str, duration: float, **kw) -> None:
+                if key.startswith(_COMPILE_PREFIX):
+                    k = key[len(_COMPILE_PREFIX):]
+                    with self._lock:
+                        self.counts[k] = self.counts.get(k, 0) + 1
+
+            _jm.register_event_duration_secs_listener(_listener)
+            self._installed = True
+            return True
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+
+_monitor = _CompileMonitor()
+
+
+class CompileWatch:
+    """Handle yielded by :func:`watch_compiles`: compile/trace deltas
+    since the context opened.  Live while the context is open; frozen
+    at context exit, so a watch handle read later reports only its own
+    block, not whatever compiled after it."""
+
+    def __init__(self) -> None:
+        self._start = _monitor.snapshot()
+        self._end: Optional[Dict[str, int]] = None
+
+    def _freeze(self) -> None:
+        self._end = _monitor.snapshot()
+
+    def _delta(self, key: str) -> int:
+        now = self._end if self._end is not None else _monitor.snapshot()
+        return now.get(key, 0) - self._start.get(key, 0)
+
+    @property
+    def compiles(self) -> int:
+        """New XLA executables built since the context opened."""
+        return self._delta(BACKEND_COMPILE)
+
+    @property
+    def traces(self) -> int:
+        """Jaxpr traces since the context opened (a retrace that hits
+        the executable cache still counts here, not in ``compiles``)."""
+        return self._delta(JAXPR_TRACE)
+
+
+@contextlib.contextmanager
+def watch_compiles() -> Iterator[CompileWatch]:
+    """Observe (don't assert) compile activity over a block."""
+    if not _monitor.install():
+        raise RuntimeError("jax.monitoring unavailable — cannot watch "
+                           "compiles")
+    w = CompileWatch()
+    try:
+        yield w
+    finally:
+        w._freeze()
+
+
+@contextlib.contextmanager
+def assert_compiles_flat(max_new: int = 0, *,
+                         what: str = "block") -> Iterator[CompileWatch]:
+    """The steady-state contract: at most ``max_new`` (default zero) new
+    XLA executables are built inside the block.  Warm up first; then
+    every device call must be a cache hit."""
+    with watch_compiles() as w:
+        yield w
+    got = w.compiles
+    assert got <= max_new, (
+        f"compile counter not flat over {what}: {got} new backend "
+        f"compile(s) (allowed {max_new}) — a steady-state path is "
+        "retracing/lowering; hunt the shape or Python-value dependence")
+
+
+def jit_cache_size(fn) -> int:
+    """Executable-cache entry count of a ``jax.jit``-wrapped callable
+    (the PR-2 assertion: the guard's skip and apply paths share ONE
+    compiled program, so this stays at 1)."""
+    getter = getattr(fn, "_cache_size", None)
+    if getter is None:
+        raise TypeError(f"{fn!r} has no _cache_size — not a jit-wrapped "
+                        "callable?")
+    return getter()
+
+
+@contextlib.contextmanager
+def jit_cache_flat(*fns, max_new: int = 0) -> Iterator[None]:
+    """Assert the given jitted callables gain at most ``max_new`` cache
+    entries (combined) inside the block — zero recompiles by default."""
+    before = sum(jit_cache_size(f) for f in fns)
+    yield
+    after = sum(jit_cache_size(f) for f in fns)
+    assert after - before <= max_new, (
+        f"jit cache grew {after - before} entr(y/ies) (allowed "
+        f"{max_new}) across {len(fns)} function(s): a new input "
+        "shape/dtype/static-arg combination retraced inside the block")
+
+
+class DeviceGetCount:
+    """Handle yielded by :func:`count_device_gets`."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+
+@contextlib.contextmanager
+def count_device_gets() -> Iterator[DeviceGetCount]:
+    """Count ``jax.device_get`` calls over a block (the transfer-budget
+    half of the deferred-drain discipline).  Patches ``jax.device_get``
+    for the span — nest-safe, restored on exit."""
+    import jax
+
+    counter = DeviceGetCount()
+    real_get = jax.device_get
+
+    def counting_get(tree):
+        counter.count += 1
+        return real_get(tree)
+
+    jax.device_get = counting_get
+    try:
+        yield counter
+    finally:
+        jax.device_get = real_get
+
+
+@contextlib.contextmanager
+def bounded_device_gets(max_gets: int, *,
+                        what: str = "block") -> Iterator[DeviceGetCount]:
+    """Assert at most ``max_gets`` device->host transfers in the block.
+
+    The train loop's budget: one batched get per log interval (plus one
+    step-counter read per epoch) — anything per-step is a regression to
+    the 4-RTTs-per-log-point stall PERF_ANALYSIS round 4 measured."""
+    with count_device_gets() as c:
+        yield c
+    assert c.count <= max_gets, (
+        f"device transfer budget exceeded over {what}: {c.count} "
+        f"jax.device_get call(s) (allowed {max_gets}) — a blocking "
+        "readback crept onto the hot path")
+
+
+@contextlib.contextmanager
+def no_tracer_leaks() -> Iterator[None]:
+    """``jax.check_tracer_leaks`` over a block: a tracer escaping its
+    trace (stashed on self, closed over and mutated) raises instead of
+    silently baking one trace's value into later calls."""
+    import jax
+
+    with jax.check_tracer_leaks():
+        yield

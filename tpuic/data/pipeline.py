@@ -34,7 +34,7 @@ import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
-from typing import Dict, Iterator, List, Optional
+from typing import Iterator, List, Optional
 
 import jax
 import numpy as np

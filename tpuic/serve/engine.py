@@ -277,7 +277,7 @@ class InferenceEngine:
                 and images.dtype == self.input_dtype):
             arr = images
         else:
-            arr = np.asarray(images, self.input_dtype)
+            arr = np.asarray(images, self.input_dtype)  # tpuic-ok: TPU101 request arrays are host-side by contract
         if arr.ndim == 3:
             arr = arr[None]
         expect = (self.image_size, self.image_size, self.channels)
@@ -391,7 +391,8 @@ class InferenceEngine:
         if _faults.fire("hang_device"):
             # 'hang_device' injection (runtime/faults.py): a stuck device
             # call, for close()/drain-timeout tests.
-            time.sleep(float(_faults.param("hang_device") or 1.0))
+            time.sleep(
+                float(_faults.param("hang_device") or 1.0))  # tpuic-ok: TPU101 fault param is a host float
         now = time.monotonic()
         self.stats.record_dispatch(bucket, rows,
                                    [now - r.t_enqueue for r in reqs])

@@ -312,7 +312,7 @@ class Trainer:
         # One readback per EPOCH for the optimizer step counter: the in-loop
         # step number is step0 + host steps, so logging never touches
         # state.step on the hot path (each device_get is a full tunnel RTT).
-        step0 = int(jax.device_get(self.state.step))
+        step0 = int(jax.device_get(self.state.step))  # tpuic-ok: TPU101 one read per EPOCH, off the steady-state path
         # Deferred logging: at log point N we SCHEDULE an async device->host
         # copy of the interval's metrics and DRAIN log point N-1, whose
         # values the device finished an interval ago — so the drain returns
@@ -358,7 +358,8 @@ class Trainer:
                 # Injected host-side stall (runtime/faults.py): a
                 # deterministic step-time regression, for trace-trigger
                 # tests — the step's work is untouched.
-                time.sleep(float(_faults.param("slow_step") or 0.05))
+                time.sleep(
+                    float(_faults.param("slow_step") or 0.05))  # tpuic-ok: TPU101 fault param is a host float
             steptime.dispatch_start()
             self.state, metrics = self.train_step(self.state, fbatch)
             steptime.dispatch_end()
@@ -422,7 +423,8 @@ class Trainer:
         # running meter only sees logged points (display semantics identical
         # to the reference bar, train.py:67-68).
         if metrics is not None and losses.count == 0:
-            losses.update(float(metrics["loss"]), 1)
+            losses.update(
+                float(metrics["loss"]), 1)  # tpuic-ok: TPU101 post-loop epoch boundary, one sync
         # Quarantine surfacing (docs/robustness.md): decode failures the
         # data layer absorbed this epoch, one console line + JSONL record
         # per epoch with events — a corrupt file is visible without being
@@ -440,7 +442,7 @@ class Trainer:
                     loss=round(losses.avg, 6))
         return losses.avg
 
-    def _drain_train_log(self, pending, losses: AverageMeter, bar,
+    def _drain_train_log(self, pending, losses: AverageMeter, bar,  # tpuic-ok: TPU101 THE deferred drain site
                          epoch: int) -> None:
         """Read one deferred log interval (a single batched device_get) and
         emit the bar description + JSONL record for it. Also the rollback
@@ -504,7 +506,7 @@ class Trainer:
         window = max(2, int(self.cfg.data.prefetch))
         pending: list = []
 
-        def drain(m, indices) -> None:
+        def drain(m, indices) -> None:  # tpuic-ok: TPU101 deferred eval drain (window W behind dispatch)
             nonlocal correct, correct5, count, loss_num, loss_den, have_top5
             nonlocal confusion
             m = jax.device_get(m)
@@ -574,7 +576,7 @@ class Trainer:
                         "support": [int(s) for s in support]}) + "\n")
         host0_print(f"Epoch: {epoch}; Val Accuracy {score:.4f}{top5_msg}; "
                     f"Val Loss {val_loss:.4f}")
-        self.logger.write(int(jax.device_get(self.state.step)),
+        self.logger.write(int(jax.device_get(self.state.step)),  # tpuic-ok: TPU101 epoch boundary
                           val_accuracy=score, val_loss=val_loss, **extra)
         _tm_publish("eval", epoch=epoch, accuracy=round(score, 4),
                     duration_s=round(time.perf_counter() - t_eval0, 3))
@@ -619,7 +621,7 @@ class Trainer:
         if run.rollback_rewarm_steps > 0:
             from tpuic.train.optimizer import make_optimizer, rewarm_scale
             steps = max(1, self.train_loader.steps_per_epoch())
-            base_step = int(np.asarray(jax.device_get(self.state.step)))
+            base_step = int(np.asarray(jax.device_get(self.state.step)))  # tpuic-ok: TPU101 rollback path, not steady state
             scale = rewarm_scale(base_step, run.rollback_rewarm_steps)
             self.state = self.state.replace(tx=make_optimizer(
                 self.cfg.optim, steps, run.epochs, lr_scale=scale))

@@ -13,7 +13,7 @@ weight, so global loss over a padded final batch is exact.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -25,7 +25,7 @@ ONE jitted XLA program over the device mesh:
 from __future__ import annotations
 
 import warnings
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -243,8 +243,11 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             if optim_cfg.mixup_alpha > 0 and optim_cfg.cutmix_alpha > 0:
                 use_mix = jax.random.bernoulli(
                     jax.random.fold_in(mix_rng, 3))
-                images, lam = jax.lax.cond(use_mix, _mixup, _cutmix,
-                                           images, partners)
+                # tpuic-ok: TPU202 cond operands are fresh mix tensors,
+                # never the donated pass-through state; the skip guard
+                # stays a jnp.where select (the PR-2 bisect's actual fix)
+                images, lam = jax.lax.cond(  # tpuic-ok: TPU202
+                    use_mix, _mixup, _cutmix, images, partners)
             elif optim_cfg.mixup_alpha > 0:
                 images, lam = _mixup(images, partners)
             else:
