@@ -46,6 +46,40 @@ def test_fault_plan_spec_and_counting():
     assert plan.fired["nan_batch"] == 2
 
 
+def test_fault_spec_rejects_unknown_point():
+    """A typo'd TPUIC_FAULTS directive must fail the run at parse time —
+    a silently-inert chaos spec would read as 'the system survived the
+    fault' when no fault ever fired (ISSUE 5 satellite)."""
+    with pytest.raises(ValueError) as ei:
+        faults.FaultPlan("nan_bach@3")
+    msg = str(ei.value)
+    assert "nan_bach" in msg              # names the offender...
+    assert "nan_batch" in msg             # ...and lists the registry
+    with pytest.raises(ValueError):
+        faults.FaultPlan("sigterm@5,hangstep@9")  # one bad entry poisons all
+
+
+def test_fault_spec_rejects_malformed_fields():
+    for bad in ("nan_batch@x", "sigterm*z", "nan_batch@3-q"):
+        with pytest.raises(ValueError, match="malformed"):
+            faults.FaultPlan(bad)
+
+
+def test_fault_spec_accepts_every_registered_point():
+    spec = ",".join(f"{p}@1" for p in sorted(faults.REGISTERED_POINTS))
+    plan = faults.FaultPlan(spec)
+    for p in faults.REGISTERED_POINTS:
+        assert plan.fire(p, step=1)
+
+
+def test_programmatic_arm_stays_unchecked():
+    """Unit tests may arm ad-hoc points; only the env-spec path (the one
+    a human can typo) validates."""
+    plan = faults.FaultPlan()
+    plan.arm("adhoc_point", steps=2)
+    assert plan.fire("adhoc_point", step=2)
+
+
 # -- non-finite step guard --------------------------------------------------
 def _tiny_step(skip_nonfinite=True, ema_decay=0.0):
     import flax.linen as nn

@@ -147,6 +147,11 @@ def test_preemption_before_first_epoch_resumes_at_zero(tmp_path):
                                size=24)
     ckpt = str(tmp_path / "ckpt0")
     trainer = Trainer(_cfg(root, ckpt, epochs=2))
+    # Pre-arming a cooperative shutdown: open the guard's span first —
+    # install() begins a FRESH span (clearing any stale latch), and
+    # fit()'s own install() is then a no-op on the already-open span, so
+    # the trigger survives.
+    trainer.preemption.install()
     trainer.preemption.trigger()  # preempted during epoch 0
     trainer.fit()
     resumed = Trainer(_cfg(root, ckpt, epochs=2))

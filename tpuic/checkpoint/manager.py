@@ -525,7 +525,11 @@ class CheckpointManager:
                             f"'{rung}' (skipped {i}: "
                             + "; ".join(failures) + ")")
             return out
-        raise RuntimeError(
+        # NonRetryable (runtime/supervisor.py exit-code contract): a
+        # supervisor restart would walk the same corrupt rungs again —
+        # report the poison instead of crash-looping on it.
+        from tpuic.runtime.supervisor import NonRetryableError
+        raise NonRetryableError(
             "no restorable checkpoint: every integrity-ladder rung failed "
             "(" + "; ".join(failures) + ")")
 

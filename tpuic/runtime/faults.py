@@ -32,12 +32,26 @@ Registered points (site → meaning of ``step``):
                       step-time regression for the telemetry trace
                       trigger (telemetry/tracing.py). ``step`` is the
                       host-tracked global optimizer step.
+- ``hard_crash``    — train loop: SIGKILL this process at the given
+                      global step — abrupt death with no flush, no
+                      atexit, no handler (the supervisor's retryable-
+                      crash + resume path, runtime/supervisor.py).
+- ``hang_step``     — train loop: stop making progress at the given
+                      global step (sleep ``param`` seconds; forever
+                      without a payload) — a wedged device call / data
+                      deadlock for the supervisor's heartbeat watchdog.
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
 ``point[@STEP|@LO-HI][*TIMES]`` directives, e.g.::
 
     TPUIC_FAULTS='nan_batch@100-105,sigterm@200' python train.py ...
+
+Spec directives are validated at parse time: naming an unregistered
+injection point (or a malformed step/times field) raises ValueError
+listing the registered points, so a typo'd chaos spec fails the run
+loudly instead of passing as "no faults fired". Programmatic ``arm()``
+stays unchecked (unit tests may use ad-hoc points).
 
 File-corruption helpers (``truncate_file``, ``corrupt_file``) live here
 too: they are the test-side tools for the *at-rest* faults (truncated
@@ -51,7 +65,17 @@ import threading
 from typing import Dict, Iterable, Optional, Union
 
 __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
-           "fire", "param", "fired", "truncate_file", "corrupt_file"]
+           "fire", "param", "fired", "truncate_file", "corrupt_file",
+           "REGISTERED_POINTS"]
+
+# Every injection point a site actually calls fire() on. TPUIC_FAULTS
+# directives must name one of these — the spec parser fails fast on
+# anything else (a typo'd chaos directive that silently never fires would
+# read as "the system survived the fault" when no fault happened).
+REGISTERED_POINTS = frozenset({
+    "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
+    "slow_step", "hard_crash", "hang_step",
+})
 
 
 class InjectedFault(RuntimeError):
@@ -82,22 +106,33 @@ class FaultPlan:
             self._parse(spec)
 
     def _parse(self, spec: str) -> None:
-        for directive in spec.split(","):
-            directive = directive.strip()
+        for raw in spec.split(","):
+            directive = raw.strip()
             if not directive:
                 continue
-            times = None
-            if "*" in directive:
-                directive, t = directive.rsplit("*", 1)
-                times = int(t)
-            steps: Optional[Iterable[int]] = None
-            if "@" in directive:
-                directive, s = directive.split("@", 1)
-                if "-" in s:
-                    lo, hi = s.split("-", 1)
-                    steps = range(int(lo), int(hi) + 1)
-                else:
-                    steps = (int(s),)
+            try:
+                times = None
+                if "*" in directive:
+                    directive, t = directive.rsplit("*", 1)
+                    times = int(t)
+                steps: Optional[Iterable[int]] = None
+                if "@" in directive:
+                    directive, s = directive.split("@", 1)
+                    if "-" in s:
+                        lo, hi = s.split("-", 1)
+                        steps = range(int(lo), int(hi) + 1)
+                    else:
+                        steps = (int(s),)
+            except ValueError:
+                raise ValueError(
+                    f"TPUIC_FAULTS: malformed directive {raw.strip()!r} "
+                    "(expected point[@STEP|@LO-HI][*TIMES])") from None
+            if directive not in REGISTERED_POINTS:
+                raise ValueError(
+                    f"TPUIC_FAULTS: unknown injection point {directive!r} "
+                    f"(registered: {', '.join(sorted(REGISTERED_POINTS))}) "
+                    "— refusing to run a chaos spec that would silently "
+                    "never fire")
             self.arm(directive, steps=steps, times=times)
 
     def arm(self, point: str, *, steps: Union[int, Iterable[int], None] = None,

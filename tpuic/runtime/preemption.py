@@ -49,16 +49,32 @@ class PreemptionGuard:
             prev(signum, frame)
 
     def install(self) -> "PreemptionGuard":
-        if self._installed:
-            return self
-        if threading.current_thread() is not threading.main_thread():
-            return self  # signal.signal would raise; poll still works via set()
+        if not self._installed:
+            # Fresh span: a latch left set by a PREVIOUS install/uninstall
+            # span (fit() N-1's SIGTERM — uninstall deliberately leaves the
+            # flag readable so callers can branch on it post-span) must not
+            # make a reused guard report 'triggered' at step 0 of the next
+            # fit(). Cleared only when beginning a new span — a trigger()
+            # fired after install() (cooperative shutdown, tests) survives
+            # the re-entrant install() calls an installed guard sees.
+            self._event.clear()
+            # Span state is marked BEFORE the thread check: off the main
+            # thread no handler can be registered, but the span is still
+            # begun — otherwise every re-entrant install() there would
+            # re-run the clear above and wipe a cooperative trigger().
+            self._installed = True
+        # Handler registration is tracked separately (by _prev) from the
+        # span flag: a span begun off the main thread still gets its
+        # handlers when a later install() runs ON the main thread — e.g.
+        # a guard constructed in a worker and handed to fit() — without
+        # re-clearing a latch set in between.
+        if self._prev or threading.current_thread() is not threading.main_thread():
+            return self  # registered already / signal.signal would raise
         for s in self._signals:
             try:
                 self._prev[s] = signal.signal(s, self._handler)
             except (ValueError, OSError):  # exotic embedding; stay inert
                 pass
-        self._installed = True
         return self
 
     def uninstall(self) -> None:

@@ -216,8 +216,28 @@ def main(argv=None) -> int:
     from tpuic.telemetry.prom import (PromServer, serve_exposition,
                                       write_exposition)
 
+    # Supervised liveness (runtime/supervisor.py, docs/robustness.md):
+    # under `python -m tpuic.supervise` the parent sets the heartbeat
+    # env; mirror engine activity (serve_batch events) into the file AND
+    # tick it from the accept loop — an idle server with no requests is
+    # alive, and the watchdog must see that, not a stale file.
+    from tpuic.runtime.supervisor import (HeartbeatWriter,
+                                          install_stack_dump_handler)
+    heartbeat = HeartbeatWriter.from_env()
+    if heartbeat is not None:
+        install_stack_dump_handler()
+        from tpuic.telemetry.events import bus as _bus
+        _bus.subscribe(heartbeat)
+
+    def _beat() -> None:
+        if heartbeat is not None:
+            heartbeat.beat()
+
     def _prom_text() -> str:
-        return serve_exposition(engine.stats.snapshot())
+        return serve_exposition(
+            engine.stats.snapshot(),
+            heartbeat_age_s=(heartbeat.age_s() if heartbeat is not None
+                             else None))
 
     prom_server = None
     if args.prom_port:
@@ -339,6 +359,7 @@ def main(argv=None) -> int:
                         if args.once or attempts[f] >= 3:
                             seen.add(f)
                 drain(block=False)
+                _beat()
                 if args.prom_dump:
                     # Per-tick refresh: a textfile collector scraping the
                     # dump sees live counters, not only the final state.
@@ -400,7 +421,9 @@ def main(argv=None) -> int:
                         break
                     if not ready:
                         drain(block=False)
+                        _beat()
                         continue
+                    _beat()
                     chunk = os.read(stdin_fd, 1 << 16)  # ready: won't block
                     if not chunk:
                         break  # EOF

@@ -1,0 +1,105 @@
+"""``python -m tpuic.supervise`` — run a trainer under the supervisor.
+
+Everything after ``--`` is the child command, launched as-is with the
+heartbeat/stack-dump environment injected (runtime/supervisor.py has the
+full protocol; docs/robustness.md the operator's view)::
+
+    python -m tpuic.supervise --watchdog-s 300 -- \\
+        python train.py --datadir /data/imagefolder --model resnet50
+
+The child is restarted with resume on retryable failures (crash, hang,
+preemption flush) under an exponential-backoff restart budget; it is
+NOT restarted on clean completion, on a non-retryable poison exit
+(code 44 — e.g. rollback budget exhausted), or when the supervisor
+itself receives SIGTERM (a shared eviction: the forwarded signal drives
+the child's preemption flush and the supervisor exits with its code).
+Liveness, hang escalation (SIGQUIT stack dump -> SIGTERM -> SIGKILL),
+the exit-code contract, and the crash-loop policy live in
+tpuic/runtime/supervisor.py.
+
+``--chaos`` (used by scripts/chaos_soak.py) assigns a per-attempt
+``TPUIC_FAULTS`` spec, semicolon-separated: attempt 0 gets the first
+spec, attempt 1 the second, …; attempts past the list run fault-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpuic.runtime.supervisor import Supervisor
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpuic.supervise", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--state-dir", default="tpuic-supervise",
+                   help="heartbeat file, progress ledger (ledger.jsonl), "
+                        "and per-attempt stack dumps land here")
+    p.add_argument("--watchdog-s", type=float, default=300.0,
+                   help="no heartbeat change for this long after the first "
+                        "beat => the child is hung (SIGQUIT stack dump, "
+                        "SIGTERM grace, SIGKILL). Must comfortably exceed "
+                        "the longest legitimate silent span — a cold "
+                        "backend compile or a full eval pass")
+    p.add_argument("--startup-grace-s", type=float, default=1800.0,
+                   help="liveness window before the FIRST heartbeat of an "
+                        "attempt (imports, checkpoint restore, and the "
+                        "first compile are legitimately silent)")
+    p.add_argument("--quit-wait-s", type=float, default=3.0,
+                   help="pause after SIGQUIT for faulthandler to finish "
+                        "writing the stack dump")
+    p.add_argument("--grace-s", type=float, default=30.0,
+                   help="SIGTERM -> SIGKILL grace (the preemption-flush "
+                        "window)")
+    p.add_argument("--poll-s", type=float, default=0.5,
+                   help="heartbeat/child poll interval")
+    p.add_argument("--max-restarts", type=int, default=16,
+                   help="retryable-failure restart budget for one "
+                        "supervised run (clean preemption flushes restart "
+                        "free: an eviction is the fleet working as "
+                        "designed, not a crash)")
+    p.add_argument("--backoff-s", type=float, default=1.0,
+                   help="initial restart backoff (doubles per consecutive "
+                        "no-progress failure, capped at --backoff-max-s; "
+                        "clean preemption flushes restart immediately)")
+    p.add_argument("--backoff-max-s", type=float, default=300.0)
+    p.add_argument("--crash-loop-k", type=int, default=3,
+                   help="consecutive restarts with no step progress before "
+                        "declaring a crash loop and giving up (exit 45)")
+    p.add_argument("--heartbeat-interval-s", type=float, default=1.0,
+                   help="child-side heartbeat write throttle")
+    p.add_argument("--chaos", default="",
+                   help="per-attempt TPUIC_FAULTS specs, ';'-separated "
+                        "(fault-injection soaks; see scripts/chaos_soak.py)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- followed by the child command")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        build_parser().print_usage(sys.stderr)
+        print("supervise: no child command (everything after '--' is the "
+              "command to supervise)", file=sys.stderr)
+        return 2
+    sup = Supervisor(
+        cmd, args.state_dir,
+        watchdog_s=args.watchdog_s, startup_grace_s=args.startup_grace_s,
+        quit_wait_s=args.quit_wait_s, grace_s=args.grace_s,
+        poll_s=args.poll_s, max_restarts=args.max_restarts,
+        backoff_s=args.backoff_s, backoff_max_s=args.backoff_max_s,
+        crash_loop_k=args.crash_loop_k,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        chaos=[s.strip() for s in args.chaos.split(";")] if args.chaos
+        else None)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

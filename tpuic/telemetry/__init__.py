@@ -85,6 +85,18 @@ class TrainTelemetry:
                 sink = JsonlSink(jsonl)
                 self._sinks.append(sink)
                 self._unsubs.append(bus.subscribe(sink))
+        # Supervised-liveness heartbeat (runtime/supervisor.py,
+        # docs/robustness.md): when a supervisor parent set
+        # TPUIC_HEARTBEAT_FILE for this process, mirror bus activity into
+        # the atomically rewritten heartbeat file. Pure host-side
+        # piggybacking on events the loop already publishes through its
+        # deferred drain — zero device syncs, zero compiles added
+        # (asserted in tests/test_supervisor.py with the
+        # tpuic.analysis.runtime checkers).
+        from tpuic.runtime.supervisor import HeartbeatWriter
+        self.heartbeat = HeartbeatWriter.from_env(publish=publish)
+        if self.heartbeat is not None:
+            self._unsubs.append(bus.subscribe(self.heartbeat))
         self.steptime = StepTimer(bus)
         flops = analytic_flops_per_step(model_name, image_size, global_batch)
         peak = peak_flops(device) * max(1, int(n_devices))

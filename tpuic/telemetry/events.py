@@ -41,7 +41,7 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 # stick to these; docs/observability.md is the schema reference.
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
-               "trace", "goodput")
+               "trace", "goodput", "restart", "heartbeat")
 
 
 @dataclasses.dataclass(frozen=True)

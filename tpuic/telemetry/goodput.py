@@ -117,7 +117,7 @@ def analytic_flops_per_step(model_name: str, image_size: int,
 
 
 _BUCKETS = ("productive", "input", "compile", "checkpoint", "skip",
-            "rollback", "eval")
+            "rollback", "eval", "restart")
 
 
 class GoodputTracker:
@@ -139,6 +139,7 @@ class GoodputTracker:
         self.steps = 0
         self.skipped_est = 0.0   # estimated skipped steps (from streaks)
         self.compiles = 0        # backend_compile count
+        self.restarts = 0        # supervisor restart count of this run
         self._pending_compile = 0.0
         self._step_total_s = 0.0  # for the rolling mean (skip estimate)
 
@@ -190,6 +191,18 @@ class GoodputTracker:
                 self.buckets["checkpoint"] += float(d.get("duration_s", 0.0))
             elif kind == "rollback":
                 self.buckets["rollback"] += float(d.get("duration_s", 0.0))
+            elif kind == "restart":
+                # Supervised restart (runtime/supervisor.py): the
+                # downtime — previous child's death through backoff,
+                # respawn, re-init, restore — happened BEFORE this
+                # process's measurement window opened. Extend the window
+                # back over it and book it to 'restart', so a run that
+                # lost 40s to a crash reports frac_restart instead of a
+                # wall clock that silently forgot the outage.
+                down = max(0.0, float(d.get("downtime_s", 0.0)))
+                self.restarts = int(d.get("restart", self.restarts + 1))
+                self._t0 -= down
+                self.buckets["restart"] += down
             elif kind == "skip":
                 # Streak delta observed at the deferred drain; charge the
                 # skipped steps at the rolling mean step time and move
@@ -242,6 +255,7 @@ class GoodputTracker:
                 out["images"] = self.steps * self.global_batch
             out["skipped_steps_est"] = round(self.skipped_est, 1)
             out["compiles"] = self.compiles
+            out["restarts"] = self.restarts
             m = self.mfu(wall)
             if m is not None:
                 out["mfu"] = round(m, 4)
@@ -251,8 +265,7 @@ class GoodputTracker:
         """One epoch-log line: the headline fractions."""
         r = self.report()
         parts = [f"wall {r['wall_s']:.1f}s"]
-        for k in ("productive", "input", "compile", "checkpoint", "skip",
-                  "rollback", "eval", "other"):
+        for k in _BUCKETS + ("other",):
             f = r.get(f"frac_{k}")
             if f:
                 parts.append(f"{k} {100.0 * f:.1f}%")

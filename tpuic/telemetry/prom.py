@@ -51,9 +51,18 @@ def render(rows: Iterable[Tuple], prefix: str = "tpuic") -> str:
     return "\n".join(out) + "\n" if out else ""
 
 
-def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve") -> str:
-    """ServeStats.snapshot() -> Prometheus text."""
+def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
+                     heartbeat_age_s: Optional[float] = None) -> str:
+    """ServeStats.snapshot() -> Prometheus text.
+
+    ``heartbeat_age_s``: seconds since the supervised-liveness heartbeat
+    file was last written (runtime/supervisor.py), when the server runs
+    under ``python -m tpuic.supervise``; omitted (None) unsupervised —
+    a scraper alerting on staleness must not see a bogus 0."""
     rows: List[Tuple] = [
+        ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
+         "seconds since the liveness heartbeat file was last written "
+         "(supervised runs only)", None),
         ("requests_total", snapshot.get("requests"), "counter",
          "requests resolved", None),
         ("images_total", snapshot.get("images"), "counter",
@@ -91,9 +100,20 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve") -> str:
 
 
 def train_exposition(report: dict, steptime: Optional[dict] = None,
-                     prefix: str = "tpuic_train") -> str:
-    """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text."""
+                     prefix: str = "tpuic_train",
+                     heartbeat_age_s: Optional[float] = None) -> str:
+    """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text.
+
+    ``heartbeat_age_s`` as in :func:`serve_exposition`; ``restart_count``
+    comes from the report's ``restarts`` field (the supervisor restart
+    this process announced at fit() start — runtime/supervisor.py)."""
     rows: List[Tuple] = [
+        ("restart_count", report.get("restarts"), "counter",
+         "supervisor restarts absorbed by this run "
+         "(runtime/supervisor.py exit-code contract)", None),
+        ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
+         "seconds since the liveness heartbeat file was last written "
+         "(supervised runs only)", None),
         ("steps_total", report.get("steps"), "counter",
          "train steps dispatched", None),
         ("wall_seconds", report.get("wall_s"), "gauge",

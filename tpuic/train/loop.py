@@ -360,6 +360,24 @@ class Trainer:
                 # tests — the step's work is untouched.
                 time.sleep(
                     float(_faults.param("slow_step") or 0.05))  # tpuic-ok: TPU101 fault param is a host float
+            if _faults.fire("hard_crash", step=step0 + step):
+                # Abrupt process death: SIGKILL to self — no flush, no
+                # atexit, no Python teardown. The supervisor
+                # (runtime/supervisor.py) must classify this as a
+                # retryable crash and restart with resume.
+                os.kill(os.getpid(), signal.SIGKILL)
+            if _faults.fire("hang_step", step=step0 + step):
+                # Wedge: stop making progress while staying alive — the
+                # shape of a stuck device call or a data-pipeline
+                # deadlock. Only the supervisor's watchdog escalation
+                # (SIGQUIT dump -> SIGTERM -> SIGKILL) ends it; the
+                # cooperative SIGTERM latch is useless here by design
+                # (the loop never reaches its next poll).
+                hang_s = _faults.param("hang_step")
+                deadline = (None if hang_s is None
+                            else time.monotonic() + float(hang_s))  # tpuic-ok: TPU101 fault param is a host float
+                while deadline is None or time.monotonic() < deadline:
+                    time.sleep(0.5)
             steptime.dispatch_start()
             self.state, metrics = self.train_step(self.state, fbatch)
             steptime.dispatch_end()
@@ -596,7 +614,11 @@ class Trainer:
         t_rb0 = time.perf_counter()
         run = self.cfg.run
         if self.rollbacks > run.max_rollbacks:
-            raise RuntimeError(
+            # NonRetryable: a supervisor restart would resume, diverge,
+            # and land right back here — the poison half of the
+            # exit-code contract (runtime/supervisor.py).
+            from tpuic.runtime.supervisor import NonRetryableError
+            raise NonRetryableError(
                 f"non-finite rollback #{self.rollbacks} exceeds "
                 f"run.max_rollbacks={run.max_rollbacks}: the run keeps "
                 "diverging after restore — fix the data/LR instead of "
@@ -607,7 +629,8 @@ class Trainer:
         # spuriously report "nothing to roll back to".
         self.ckpt.wait()
         if self.ckpt.newest_track() is None:
-            raise RuntimeError(
+            from tpuic.runtime.supervisor import NonRetryableError
+            raise NonRetryableError(
                 f"{run.skip_threshold} consecutive non-finite steps before "
                 "any checkpoint existed — nothing to roll back to (the "
                 "guard kept the state finite; lower the LR or check the "
@@ -666,6 +689,21 @@ class Trainer:
             self.preemption.install()
         goodput = self.telemetry.goodput
         goodput.start()
+        # Supervised restart (runtime/supervisor.py): announce it as a
+        # typed event. The downtime — previous child's death through
+        # backoff, respawn, re-init, and checkpoint restore to here — is
+        # charged to the goodput 'restart' bucket, so post-restart wall
+        # time is classified instead of vanishing into 'other'.
+        from tpuic.runtime.supervisor import restart_info
+        rinfo = restart_info()
+        if rinfo is not None:
+            count, downtime_s = rinfo
+            host0_print(f"[supervise] restart #{count}: resumed at epoch "
+                        f"{self.start_epoch} step {self.start_step} after "
+                        f"{downtime_s:.1f}s downtime")
+            _tm_publish("restart", restart=count,
+                        downtime_s=round(downtime_s, 3),
+                        epoch=self.start_epoch, step_in_epoch=self.start_step)
         self._steps_exhausted = False
         try:
             epoch = self.start_epoch

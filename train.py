@@ -198,6 +198,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 disables the automatic trigger)")
     p.add_argument("--trace-steps", type=int, default=3,
                    help="steps each triggered trace window covers")
+    p.add_argument("--prom-dump", default="",
+                   help="write the train Prometheus exposition (goodput "
+                        "fractions, MFU, step-time percentiles, restart "
+                        "count, heartbeat age) to this file atomically at "
+                        "every goodput report — the textfile-collector "
+                        "transport, same as tpuic.serve's flag")
     return p
 
 
@@ -265,6 +271,15 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # Supervision protocol (runtime/supervisor.py, docs/robustness.md):
+    # register the SIGQUIT faulthandler stack dump FIRST — a hang
+    # anywhere after this line, including inside the backend probe or
+    # the first compile, must still be explainable when the supervisor's
+    # watchdog escalates. Stdlib-only, costs nothing unsupervised.
+    from tpuic.runtime.supervisor import (EXIT_POISON, EXIT_PREEMPTED,
+                                          NonRetryableError,
+                                          install_stack_dump_handler)
+    install_stack_dump_handler()
     # Dev-image guard: probe the tunneled TPU backend (whose init HANGS,
     # not errors, when the tunnel is down) and fall back to CPU with a
     # message instead of hanging the training command.
@@ -278,11 +293,49 @@ def main(argv=None) -> int:
     host0_print(f"[tpuic] {info.process_count} process(es), "
                 f"{info.global_device_count} {info.platform} device(s)")
     cfg = config_from_args(args)
-    trainer = Trainer(cfg, log_dir=args.log_dir or None)
+    try:
+        # Construction is in the poison scope too: a --resume restore
+        # that finds every checkpoint rung corrupt raises here, before
+        # fit() — it must exit 44, not crash-loop the supervisor through
+        # the same corrupt rungs.
+        trainer = Trainer(cfg, log_dir=args.log_dir or None)
+    except NonRetryableError as e:
+        host0_print(f"[tpuic] NON-RETRYABLE: {e}")
+        return EXIT_POISON
     host0_print(f"[tpuic] model={trainer.model.backbone.__class__.__name__} "
                 f"classes={trainer.model.num_classes} "
                 f"mesh={dict(trainer.mesh.shape)}")
-    best = trainer.fit()
+    if args.prom_dump:
+        # Textfile-collector exposition, refreshed at each goodput report
+        # (per epoch + final): the trainer already publishes the full
+        # report as a 'goodput' event, so the dump is one more host-side
+        # bus subscriber — no new syncs, no polling thread.
+        from tpuic.metrics.logging import is_host0
+        from tpuic.telemetry.events import subscribe
+        from tpuic.telemetry.prom import train_exposition, write_exposition
+        if is_host0():
+            def _prom_dump(ev) -> None:
+                hb = trainer.telemetry.heartbeat
+                write_exposition(args.prom_dump, train_exposition(
+                    dict(ev.data),
+                    trainer.telemetry.steptime.summary(),
+                    heartbeat_age_s=hb.age_s() if hb is not None else None))
+            subscribe(_prom_dump, kinds=("goodput",))
+    try:
+        best = trainer.fit()
+    except NonRetryableError as e:
+        # The poison half of the exit-code contract: a supervisor restart
+        # cannot fix this (rollback budget exhausted, every checkpoint
+        # rung corrupt) — exit 44 so it reports instead of crash-looping.
+        host0_print(f"[tpuic] NON-RETRYABLE: {e}")
+        return EXIT_POISON
+    if cfg.run.handle_preemption and trainer.preemption.triggered:
+        # Clean preemption flush: the step-exact 'latest' checkpoint is
+        # committed — exit 43 so a supervisor restarts with resume
+        # (immediately, no backoff) instead of booking a crash.
+        host0_print(f"[tpuic] preempted (flushed); best val accuracy "
+                    f"{best:.4f}")
+        return EXIT_PREEMPTED
     host0_print(f"[tpuic] done; best val accuracy {best:.4f}")
     return 0
 
