@@ -321,3 +321,114 @@ def test_serve_main_watch_once(tmp_path, monkeypatch, capsys):
         assert ln["pred"] in {"0", "1", "2"}
         assert 0.0 <= ln["prob"] <= 1.0
         assert len(ln["topk"]) == 2
+
+
+# -- request-scoped tracing (span ledger, docs/observability.md) -------------
+def test_serve_span_ledger_reconciles_and_traces_unique():
+    """Every resolved request publishes one serve_span event whose
+    phases sum to its end-to-end total by construction, with a unique
+    trace id (also mirrored on the returned Future)."""
+    from tpuic.serve.metrics import SPAN_PHASES
+    from tpuic.telemetry.events import MemorySink, bus
+
+    ms = MemorySink()
+    unsub = bus.subscribe(ms, kinds=("serve_span",))
+    eng = _engine(max_wait_ms=2.0)
+    try:
+        rng = np.random.default_rng(4)
+        futs = [eng.submit(_imgs(rng, int(rng.integers(1, 5))))
+                for _ in range(10)]
+        for f in futs:
+            f.result(timeout=30)
+            assert isinstance(f.tpuic_trace, int)
+        deadline = time.monotonic() + 5.0
+        while (len(ms.of("serve_span")) < 10
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        eng.close()
+        unsub()
+    evs = ms.of("serve_span")
+    assert len(evs) == 10
+    assert len({e.data["trace"] for e in evs}) == 10
+    assert ({e.data["trace"] for e in evs}
+            == {f.tpuic_trace for f in futs})
+    for e in evs:
+        d = e.data
+        assert all(d[f"{p}_ms"] >= 0.0 for p in SPAN_PHASES), d
+        span_sum = sum(d[f"{p}_ms"] for p in SPAN_PHASES)
+        # phases are cumulative-timestamp differences: they sum to the
+        # total exactly (up to per-field rounding)
+        assert span_sum == pytest.approx(d["total_ms"], abs=0.01)
+        assert d["bucket"] in eng.buckets
+        assert 1 <= d["rows"] <= 4
+    # the stats-side span meters recorded every phase for every request
+    snap = eng.stats.snapshot()
+    assert set(snap["span_ms"]) == set(SPAN_PHASES)
+
+
+def test_serve_span_total_matches_measured_latency():
+    """The ledger must reconcile with latency measured OUTSIDE the
+    engine: a blocking caller's submit->result wall bounds the span
+    total from above (the total closes before the future wakes the
+    caller), and the two agree to within scheduler noise."""
+    from tpuic.telemetry.events import MemorySink, bus
+
+    ms = MemorySink()
+    unsub = bus.subscribe(ms, kinds=("serve_span",))
+    eng = _engine(buckets=(1, 2), max_wait_ms=0.0)
+    try:
+        rng = np.random.default_rng(5)
+        walls = []
+        for _ in range(6):
+            t0 = time.monotonic()
+            eng.predict(_imgs(rng, 1))
+            walls.append(1000.0 * (time.monotonic() - t0))
+        deadline = time.monotonic() + 5.0
+        while (len(ms.of("serve_span")) < 6
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        eng.close()
+        unsub()
+    evs = ms.of("serve_span")
+    assert len(evs) == 6
+    for e, wall in zip(evs, walls):
+        total = e.data["total_ms"]
+        assert total <= wall + 1.0          # total closes inside the wall
+        assert wall - total < 250.0         # and not wildly below it
+
+
+def test_serve_span_tracing_adds_zero_syncs_zero_compiles():
+    """The tracing contract (ISSUE 6 acceptance): publishing span
+    ledgers is host-clock arithmetic — the compile counter stays flat
+    after warmup and the jax.device_get count is IDENTICAL with span
+    subscribers on vs. off (tpuic.analysis runtime checkers)."""
+    from tpuic.analysis.runtime import (assert_compiles_flat,
+                                        count_device_gets)
+    from tpuic.telemetry.events import MemorySink, bus
+
+    def stream(eng, seed):
+        rng = np.random.default_rng(seed)
+        futs = [eng.submit(_imgs(rng, int(rng.integers(1, 5))))
+                for _ in range(12)]
+        for f in futs:
+            f.result(timeout=30)
+
+    eng = _engine(max_wait_ms=1.0)
+    try:
+        eng.warmup()
+        with count_device_gets() as gets_off:
+            stream(eng, 7)
+        ms = MemorySink()
+        unsub = bus.subscribe(ms, kinds=("serve_span",))
+        try:
+            with assert_compiles_flat(0, what="span-traced stream"):
+                with count_device_gets() as gets_on:
+                    stream(eng, 7)
+        finally:
+            unsub()
+    finally:
+        eng.close()
+    assert gets_on.count == gets_off.count
+    assert len(ms.of("serve_span")) == 12

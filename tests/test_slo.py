@@ -1,0 +1,362 @@
+"""ISSUE 6 acceptance: SLO accounting, the pinned nearest-rank quantile,
+JSONL sink durability, TensorBoardSink's new event kinds, and the
+noise-aware perf-regression gate (bidirectional: passes clean, fails
+under a seeded slowdown fault)."""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.metrics.meters import (LatencyMeter, quantile, quantile_label,
+                                  quantiles)
+from tpuic.runtime import faults
+from tpuic.telemetry import events as tme
+from tpuic.telemetry.events import (EventBus, JsonlSink, MemorySink,
+                                    TensorBoardSink)
+from tpuic.telemetry.slo import (METRIC_EVENTS, SLOTracker, parse_objective,
+                                 parse_objectives)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- the pinned quantile method ----------------------------------------------
+def test_quantile_nearest_rank_pinned():
+    """The documented method is nearest-rank: ceil(q/100 * n), 1-based —
+    every reported value is an actually-observed sample."""
+    data = list(range(1, 101))  # 1..100
+    assert quantile(data, 50) == 50
+    assert quantile(data, 99) == 99
+    assert quantile(data, 99.9) == 100
+    assert quantile(data, 1) == 1
+    assert quantile([1.0, 2.0, 3.0], 50) == 2.0
+    assert quantile([7.5], 99.9) == 7.5     # single sample: itself
+    assert quantile([3, 1, 2], 100) == 3    # sorts internally
+    with pytest.raises(ValueError):
+        quantile([], 50)
+    assert quantile_label(50) == "p50"
+    assert quantile_label(99.9) == "p999"
+    qs = quantiles([1, 2, 3, 4], (50, 99.9))
+    assert qs == {"p50": 2, "p999": 4}
+    assert quantiles([], (50,)) == {}
+
+
+def test_latency_meter_uses_shared_quantile_and_p999():
+    m = LatencyMeter()
+    for v in (0.010, 0.020, 0.030, 0.040):
+        m.update(v)
+    p = m.percentiles_ms()
+    assert set(p) == {"p50", "p95", "p99", "p999"}
+    # nearest-rank: p50 of 4 samples is the 2nd (20 ms), and every
+    # value is a real sample — never an interpolation
+    assert p["p50"] == 20.0
+    assert p["p999"] == 40.0
+    assert all(v in (10.0, 20.0, 30.0, 40.0) for v in p.values())
+
+
+# -- SLO objectives ----------------------------------------------------------
+def test_parse_objective_grammar():
+    o = parse_objective("serve_latency:p99<=50ms")
+    assert (o.metric, o.quantile, o.threshold_ms) == ("serve_latency",
+                                                      99.0, 50.0)
+    assert o.target == 0.99                  # implied by the quantile
+    assert o.name == "serve_latency_p99"
+    o2 = parse_objective("train_step:p50<=400ms@0.95")
+    assert o2.target == 0.95 and o2.name == "train_step_p50"
+    assert parse_objectives("") == []
+    assert len(parse_objectives(
+        "serve_latency:p99<=50ms,train_step:p50<=1ms")) == 2
+    for bad in ("nope:p99<=5ms", "serve_latency:p99<=xms",
+                "serve_latency:p99<=5ms@1.5", "serve_latency:p99<=0ms",
+                "serve_latency p99"):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+
+def test_slo_tracker_attainment_and_burn():
+    """90% attainment against a 0.99 target burns budget at 10x; a clean
+    objective burns at 0 with full budget remaining."""
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    tr = SLOTracker(parse_objectives(
+        "serve_latency:p99<=10ms,train_step:p50<=100ms"),
+        window=64, publish_every=5)
+    assert set(tr.kinds()) == {"serve_span", "step"}
+    tr.attach(bus)
+    for i in range(20):
+        bus.publish("serve_span", trace=i,
+                    total_ms=50.0 if i % 10 == 0 else 5.0)
+        bus.publish("step", step=i, total_ms=80.0)
+    rep = tr.report()
+    serve, train = rep["objectives"]
+    assert serve["attainment"] == pytest.approx(0.9)
+    assert serve["burn_rate"] == pytest.approx(10.0)       # 0.1 / 0.01
+    assert serve["budget_remaining"] == pytest.approx(-9.0)
+    assert serve["current_ms"] == 50.0                     # real sample
+    assert train["attainment"] == 1.0
+    assert train["burn_rate"] == 0.0
+    assert train["budget_remaining"] == 1.0
+    # slo events at the publish cadence: 20 samples / 5 per objective
+    assert len(ms.of("slo")) == 8
+    names = {e.data["name"] for e in ms.of("slo")}
+    assert names == {"serve_latency_p99", "train_step_p50"}
+    assert "burn 10.00x" in tr.summary_line()
+
+
+def test_slo_rows_render_in_expositions():
+    from tpuic.telemetry.prom import serve_exposition, train_exposition
+    bus = EventBus()
+    tr = SLOTracker(parse_objectives("serve_latency:p99<=10ms"), window=8)
+    tr.attach(bus)
+    for ms_v in (5.0, 5.0, 50.0, 5.0):
+        bus.publish("serve_span", total_ms=ms_v)
+    text = serve_exposition({"requests": 4}, slo=tr.report())
+    assert 'tpuic_serve_slo_attainment{slo="serve_latency_p99"} 0.75' in text
+    assert 'tpuic_serve_slo_burn_rate{slo="serve_latency_p99"} 25' in text
+    assert 'tpuic_serve_slo_threshold_ms{slo="serve_latency_p99"} 10' in text
+    t2 = train_exposition({"steps": 1}, slo=tr.report())
+    assert 'tpuic_train_slo_attainment' in t2
+    # no-SLO expositions are unchanged (no bogus rows)
+    assert "slo_" not in serve_exposition({"requests": 4})
+
+
+def test_slo_tracker_drives_engine_spans():
+    """Attaching an SLO tracker to the global bus is what switches the
+    engine's per-request span publishing on — and the tracker then
+    accounts every request."""
+    from tpuic.serve import InferenceEngine
+
+    def fwd(variables, images):
+        return jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+
+    tr = SLOTracker(parse_objectives("serve_latency:p99<=60000ms"),
+                    window=64)
+    unsub = tr.attach(tme.bus)
+    eng = InferenceEngine(forward_fn=fwd, variables={}, image_size=4,
+                          buckets=(1, 2, 4), max_wait_ms=1.0)
+    try:
+        rng = np.random.default_rng(0)
+        futs = [eng.submit(rng.standard_normal(
+            (1, 4, 4, 3)).astype(np.float32)) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+        deadline = time.monotonic() + 5.0
+        while (tr.report()["objectives"][0]["samples"] < 8
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        eng.close()
+        unsub()
+    obj = tr.report()["objectives"][0]
+    assert obj["samples"] == 8
+    assert obj["attainment"] == 1.0  # nothing beats a 60 s threshold
+
+
+# -- JSONL sink durability (satellite) ---------------------------------------
+def test_jsonl_sink_interval_flush_and_fsync(tmp_path):
+    """With a large flush_every, the time-bounded flush still gets lines
+    to the OS; fsync mode flushes through close; write-after-close is a
+    no-op."""
+    path = str(tmp_path / "ev.jsonl")
+    sink = JsonlSink(path, flush_every=10_000, flush_interval_s=0.0)
+    bus = EventBus()
+    bus.subscribe(sink)
+    bus.publish("step", step=1)
+    # interval 0: flushed on the very first event despite flush_every
+    with open(path) as f:
+        assert json.loads(f.readline())["step"] == 1
+    sink.close()
+
+    path2 = str(tmp_path / "ev2.jsonl")
+    sink2 = JsonlSink(path2, flush_every=10_000, flush_interval_s=3600.0)
+    bus2 = EventBus()
+    bus2.subscribe(sink2)
+    bus2.publish("step", step=7)
+    assert os.path.getsize(path2) == 0   # buffered: neither bound hit
+    sink2.close()                        # clean drain flushes the tail
+    assert json.loads(open(path2).readline())["step"] == 7
+    bus2.publish("step", step=8)         # write-after-close: no-op
+    assert len(open(path2).readlines()) == 1
+
+    path3 = str(tmp_path / "ev3.jsonl")
+    sink3 = JsonlSink(path3, fsync=True)
+    sink3(tme.Event("goodput", time.time(), {"mfu": 0.5}))
+    assert json.loads(open(path3).readline())["mfu"] == 0.5
+    sink3.close()
+    sink3.close()  # idempotent
+
+
+# -- TensorBoardSink's new kinds (satellite) ---------------------------------
+class _StubWriter:
+    def __init__(self):
+        self.calls = []
+
+    def scalars(self, step, **values):
+        self.calls.append((step, values))
+
+
+def test_tensorboard_sink_serve_restart_and_slo_kinds():
+    tb = _StubWriter()
+    sink = TensorBoardSink(tb)
+    sink(tme.Event("step", 0.0, {"step": 41}))
+    sink(tme.Event("restart", 0.0, {"restart": 2, "downtime_s": 3.5}))
+    sink(tme.Event("serve_batch", 0.0,
+                   {"bucket": 8, "requests": 3, "images": 6,
+                    "latency_ms": 12.5}))
+    sink(tme.Event("serve_span", 0.0,
+                   {"trace": 1, "total_ms": 9.0, "queue_ms": 1.0,
+                    "device_ms": 6.0}))
+    sink(tme.Event("slo", 0.0,
+                   {"name": "serve_latency_p99", "attainment": 0.98,
+                    "burn_rate": 2.0, "budget_remaining": -1.0}))
+    flat = {k: (s, v) for s, kv in tb.calls for k, v in kv.items()}
+    assert flat["restarts"] == (41, 2.0)
+    assert flat["restart_downtime_s"] == (41, 3.5)
+    assert flat["serve_batch_latency_ms"] == (1, 12.5)
+    assert flat["serve_batch_images"] == (1, 6.0)
+    assert flat["serve_request_total_ms"] == (1, 9.0)
+    assert flat["serve_request_device_ms"] == (1, 6.0)
+    assert flat["slo_serve_latency_p99_attainment"] == (41, 0.98)
+    assert flat["slo_serve_latency_p99_burn_rate"] == (41, 2.0)
+
+
+# -- fault spec #PARAM (the gate's severity dial) ----------------------------
+def test_fault_spec_param_payload():
+    plan = faults.FaultPlan("slow_step#0.25,hang_device@3#1.5")
+    assert plan.param("slow_step") == 0.25
+    assert plan.fire("slow_step", step=99)          # any step
+    assert plan.param("hang_device") == 1.5
+    assert plan.fire("hang_device", step=3)
+    assert not plan.fire("hang_device", step=4)     # @3 still honored
+    with pytest.raises(ValueError, match="malformed"):
+        faults.FaultPlan("slow_step#fast")
+
+
+# -- perf-regression gate ----------------------------------------------------
+def _baseline(metrics, cal=0.01, noise=0.05):
+    from tpuic.telemetry.regress import SCHEMA
+    return {"schema": SCHEMA, "calibration_s": cal,
+            "metrics": {k: {"value": v, "noise": noise}
+                        for k, v in metrics.items()}}
+
+
+BASE = {
+    "train.mfu": 0.02, "train.step_p50_ms": 100.0,
+    "train.step_p99_ms": 140.0, "train.frac_productive": 0.5,
+    "train.accounted_frac": 0.99, "serve.latency_p50_ms": 20.0,
+    "serve.latency_p99_ms": 45.0,
+    "serve.throughput_images_per_sec": 300.0,
+    "serve.pad_efficiency": 0.8, "serve.steady_compiles": 0.0,
+}
+
+
+def test_regress_compare_clean_and_directions():
+    from tpuic.telemetry.regress import compare
+    rep = compare(_baseline(BASE), dict(BASE), 0.01)
+    assert not rep["regressed"]
+    assert all(r["status"] == "ok" for r in rep["rows"])
+
+    # lower-better metric doubling regresses, and the report NAMES it
+    worse = dict(BASE, **{"serve.latency_p99_ms": 45.0 * 4})
+    rep = compare(_baseline(BASE), worse, 0.01)
+    assert rep["regressed"]
+    assert rep["regressed_metrics"] == ["serve.latency_p99_ms"]
+
+    # higher-better metric halving (MFU) regresses
+    rep = compare(_baseline(BASE), dict(BASE, **{"train.mfu": 0.005}),
+                  0.01)
+    assert "train.mfu" in rep["regressed_metrics"]
+
+    # exact counter: ONE steady-state compile is a regression
+    rep = compare(_baseline(BASE),
+                  dict(BASE, **{"serve.steady_compiles": 1.0}), 0.01)
+    assert "serve.steady_compiles" in rep["regressed_metrics"]
+
+    # an IMPROVEMENT never trips the gate
+    better = dict(BASE, **{"serve.latency_p99_ms": 10.0,
+                           "train.mfu": 0.05})
+    assert not compare(_baseline(BASE), better, 0.01)["regressed"]
+
+
+def test_regress_calibration_scaling_and_snap():
+    from tpuic.telemetry.regress import compare
+    # 2x slower machine: time metrics double, rates halve — NOT a
+    # regression once calibration-scaled
+    slower = {k: (v * 2 if k.endswith("_ms")
+                  else v / 2 if k in ("train.mfu",
+                                      "serve.throughput_images_per_sec")
+                  else v) for k, v in BASE.items()}
+    rep = compare(_baseline(BASE, cal=0.01), slower, 0.02)
+    assert not rep["regressed"], rep["regressed_metrics"]
+    assert rep["scale"] == 2.0
+    # near-1 ratios snap to exactly 1 (same-machine band): a 20%
+    # calibration wobble must not move expectations at all
+    rep = compare(_baseline(BASE, cal=0.01), dict(BASE), 0.012)
+    assert rep["scale"] == 1.0
+    assert "snapped" in rep["calibration"]
+    # and a genuinely slow machine without scaling WOULD have failed
+    rep_noscale = compare(_baseline(BASE, cal=0.01), slower, 0.01)
+    assert rep_noscale["regressed"]
+
+
+def test_regress_tolerance_ladder_uses_noise_band():
+    from tpuic.telemetry.regress import NOISE_MULT, compare
+    # noise 0.3 -> tol 4*0.3 = 1.2 for a floor-0.5 metric: a 2x step
+    # time sits INSIDE the band (noisy baseline widens the gate)...
+    noisy = _baseline(BASE, noise=0.3)
+    rep = compare(noisy, dict(BASE, **{"train.step_p50_ms": 200.0}), 0.01)
+    assert "train.step_p50_ms" not in rep["regressed_metrics"]
+    row = next(r for r in rep["rows"] if r["metric"] == "train.step_p50_ms")
+    assert row["tolerance"] == pytest.approx(NOISE_MULT * 0.3)
+    # ...while a quiet baseline catches the same 2x
+    rep = compare(_baseline(BASE, noise=0.01),
+                  dict(BASE, **{"train.step_p50_ms": 200.0}), 0.01)
+    assert "train.step_p50_ms" in rep["regressed_metrics"]
+
+
+def test_regress_missing_metrics_are_reported_not_fatal():
+    from tpuic.telemetry.regress import compare
+    fresh = {k: v for k, v in BASE.items() if not k.startswith("train.")}
+    rep = compare(_baseline(BASE), fresh, 0.01)
+    assert not rep["regressed"]
+    missing = [r["metric"] for r in rep["rows"] if r["status"] == "missing"]
+    assert "train.mfu" in missing
+
+
+def _stub_forward(variables, images):
+    s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+    return s
+
+
+def test_regress_serve_workload_bidirectional():
+    """The gate proof on the REAL engine workload: a clean re-run passes
+    against a just-written baseline; the same workload under a seeded
+    hang_device fault fails naming a serve latency metric."""
+    from tpuic.telemetry.regress import (calibration_s, compare,
+                                         make_baseline, serve_workload)
+    cal = calibration_s(reps=2, n=200_000)
+    clean = serve_workload(requests=24, forward_fn=_stub_forward)
+    assert clean["serve.steady_compiles"] == 0.0
+    baseline = make_baseline([clean], cal, {"serve_requests": 24})
+    rerun = serve_workload(requests=24, forward_fn=_stub_forward)
+    rep = compare(baseline, rerun, cal)
+    assert not rep["regressed"], rep["regressed_metrics"]
+
+    faults.arm("hang_device", param=0.25)
+    try:
+        degraded = serve_workload(requests=24, forward_fn=_stub_forward)
+    finally:
+        faults.disarm("hang_device")
+    rep = compare(baseline, degraded, cal)
+    assert rep["regressed"]
+    assert any(m.startswith("serve.latency") for m in
+               rep["regressed_metrics"]), rep["regressed_metrics"]

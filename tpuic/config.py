@@ -323,6 +323,11 @@ class RunConfig:
     trace_threshold: float = 3.0
     trace_steps: int = 3
     trace_keep: int = 4
+    # Step-time SLOs (telemetry/slo.py): comma list of objective specs,
+    # e.g. 'train_step:p99<=500ms@0.99'. Rolling attainment and
+    # error-budget burn rate ride the goodput log line, the 'slo' bus
+    # events, and the Prometheus exposition. '' disables.
+    slo: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,3 +1,4 @@
 from tpuic.metrics.meters import (AverageMeter, LatencyMeter,  # noqa: F401
-                                  accuracy, topk_accuracy)
+                                  accuracy, quantile, quantile_label,
+                                  quantiles, topk_accuracy)
 from tpuic.metrics.logging import host0_print, MetricLogger  # noqa: F401

@@ -14,8 +14,54 @@ TPU-native re-design of the reference's ``utils.py``:
 
 from __future__ import annotations
 
+import math
+from typing import Dict, Iterable, Sequence
+
 import jax
 import jax.numpy as jnp
+
+
+# -- the one quantile implementation -----------------------------------------
+# Pinned method: **nearest-rank** (R-1 / inverse-CDF) — the reported value
+# is always an actually-observed sample, never an interpolation between
+# two samples.  For latency SLOs that property matters: "p99 = 38 ms"
+# means a real request took 38 ms, and a single outlier moves the tail
+# quantiles by whole samples, not by interpolation fractions.  Every
+# percentile this repo reports (serve stats, the telemetry StepTimer,
+# request span ledgers, SLO attainment, the perf-regression gate) goes
+# through these two helpers — there is deliberately no second copy.
+
+def _rank(n: int, q: float) -> int:
+    """THE nearest-rank formula: ceil(q/100 * n), clamped to [1, n].
+    Both public readers index with this one expression — a change to
+    the pinned method lands everywhere or nowhere."""
+    return max(1, min(n, math.ceil(q / 100.0 * n)))
+
+
+def quantile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of ``samples`` (``q`` in percent, 0-100).
+
+    Returns the rank-th smallest sample.  Raises on an empty sequence
+    (callers that want "no data yet" semantics check first — a
+    fabricated 0 would read as a perfect latency)."""
+    s = sorted(samples)
+    if not s:
+        raise ValueError("quantile of empty sample set")
+    return s[_rank(len(s), q) - 1]
+
+
+def quantile_label(q: float) -> str:
+    """Canonical metric key for a quantile: 50 -> 'p50', 99.9 -> 'p999'."""
+    return "p" + format(float(q), "g").replace(".", "")
+
+
+def quantiles(samples: Sequence[float],
+              qs: Iterable[float]) -> Dict[str, float]:
+    """{label: nearest-rank quantile} over one shared sort ({} if empty)."""
+    s = sorted(samples)
+    if not s:
+        return {}
+    return {quantile_label(q): s[_rank(len(s), q) - 1] for q in qs}
 
 
 class AverageMeter:
@@ -41,12 +87,17 @@ class AverageMeter:
 class LatencyMeter:
     """Latency percentile tracker over a bounded sliding window.
 
-    ``update`` records one sample (seconds); ``percentiles`` reads
-    p50/p95/p99 (milliseconds) over the last ``window`` samples, so a
-    long-running server reports recent behavior rather than its whole
+    ``update`` records one sample (seconds); ``percentiles_ms`` reads
+    p50/p95/p99/p999 (milliseconds) over the last ``window`` samples, so
+    a long-running server reports recent behavior rather than its whole
     lifetime.  count/total cover every sample ever recorded (for
     throughput math).  Not thread-safe by itself — callers that update
     from several threads hold their own lock (tpuic.serve.metrics does).
+
+    Percentile method is the module-level nearest-rank :func:`quantile`
+    (pinned and documented there): reported values are real observed
+    samples, shared with the serve span ledger, SLO accounting, and the
+    perf-regression gate — one implementation, one semantics.
     """
 
     def __init__(self, window: int = 8192) -> None:
@@ -66,15 +117,11 @@ class LatencyMeter:
         self.count += 1
         self.total += s
 
-    def percentiles_ms(self, qs=(50, 95, 99)) -> dict:
-        """{'p50': ms, ...} over the window; {} when no samples yet."""
-        if not self._win:
-            return {}
-        import numpy as np
-        arr = np.asarray(self._win, np.float64)
-        vals = np.percentile(arr, qs)
-        return {f"p{q}": round(1000.0 * float(v), 3)
-                for q, v in zip(qs, vals)}
+    def percentiles_ms(self, qs=(50, 95, 99, 99.9)) -> dict:
+        """{'p50': ms, ..., 'p999': ms} over the window (nearest-rank,
+        see :func:`quantile`); {} when no samples yet."""
+        return {k: round(1000.0 * v, 3)
+                for k, v in quantiles(self._win, qs).items()}
 
     @property
     def mean_ms(self) -> float:

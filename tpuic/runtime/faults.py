@@ -43,9 +43,15 @@ Registered points (site → meaning of ``step``):
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
-``point[@STEP|@LO-HI][*TIMES]`` directives, e.g.::
+``point[@STEP|@LO-HI][*TIMES][#PARAM]`` directives, e.g.::
 
     TPUIC_FAULTS='nan_batch@100-105,sigterm@200' python train.py ...
+    TPUIC_FAULTS='slow_step#0.3' python train.py ...   # 0.3 s per step
+
+``#PARAM`` (a float) sets the point's payload — the sleep seconds of
+``slow_step``/``hang_device``/``hang_step`` — so a chaos spec can dial
+the severity (the perf-regression gate seeds a decisive slowdown this
+way; telemetry/regress.py).
 
 Spec directives are validated at parse time: naming an unregistered
 injection point (or a malformed step/times field) raises ValueError
@@ -111,6 +117,10 @@ class FaultPlan:
             if not directive:
                 continue
             try:
+                param = None
+                if "#" in directive:
+                    directive, pv = directive.rsplit("#", 1)
+                    param = float(pv)
                 times = None
                 if "*" in directive:
                     directive, t = directive.rsplit("*", 1)
@@ -126,14 +136,15 @@ class FaultPlan:
             except ValueError:
                 raise ValueError(
                     f"TPUIC_FAULTS: malformed directive {raw.strip()!r} "
-                    "(expected point[@STEP|@LO-HI][*TIMES])") from None
+                    "(expected point[@STEP|@LO-HI][*TIMES][#PARAM])"
+                ) from None
             if directive not in REGISTERED_POINTS:
                 raise ValueError(
                     f"TPUIC_FAULTS: unknown injection point {directive!r} "
                     f"(registered: {', '.join(sorted(REGISTERED_POINTS))}) "
                     "— refusing to run a chaos spec that would silently "
                     "never fire")
-            self.arm(directive, steps=steps, times=times)
+            self.arm(directive, steps=steps, times=times, param=param)
 
     def arm(self, point: str, *, steps: Union[int, Iterable[int], None] = None,
             times: Optional[int] = None, param=None) -> None:

@@ -192,7 +192,25 @@ def main(argv=None) -> int:
                    help="write the Prometheus text exposition to this "
                         "file on shutdown (and each poll tick under "
                         "--watch) — the textfile-collector transport")
+    p.add_argument("--slo", default="",
+                   help="latency SLOs, comma list of "
+                        "'serve_latency:pQ<=Nms[@target]' specs "
+                        "(telemetry/slo.py). Subscribing the tracker is "
+                        "what switches per-request span events on; "
+                        "attainment and error-budget burn land in the "
+                        "Prometheus exposition and the final stats line")
     args = p.parse_args(argv)
+
+    slo_tracker = None
+    if args.slo:
+        # Parse BEFORE the checkpoint load + AOT warmup — a typo'd
+        # objective must fail the command line, not minutes in.
+        from tpuic.telemetry.slo import SLOTracker, parse_objectives
+        try:
+            slo_tracker = SLOTracker(parse_objectives(
+                args.slo, allowed=("serve_latency",)))
+        except ValueError as e:
+            raise SystemExit(f"serve: --slo: {e}")
 
     # Install the latch BEFORE the (potentially minutes-long) checkpoint
     # load + AOT warmup: an eviction during startup must also exit
@@ -233,11 +251,20 @@ def main(argv=None) -> int:
         if heartbeat is not None:
             heartbeat.beat()
 
+    if slo_tracker is not None:
+        # Attaching subscribes for 'serve_span' events, which is exactly
+        # what turns the engine's per-request span publishing on
+        # (engine._resolve checks bus.active("serve_span")).
+        from tpuic.telemetry.events import bus as _slo_bus
+        slo_tracker.attach(_slo_bus)
+
     def _prom_text() -> str:
         return serve_exposition(
             engine.stats.snapshot(),
             heartbeat_age_s=(heartbeat.age_s() if heartbeat is not None
-                             else None))
+                             else None),
+            slo=(slo_tracker.report() if slo_tracker is not None
+                 else None))
 
     prom_server = None
     if args.prom_port:
@@ -457,6 +484,9 @@ def main(argv=None) -> int:
                       file=sys.stderr)
             except OSError as e:
                 print(f"[serve] prom dump failed: {e}", file=sys.stderr)
+        if slo_tracker is not None:
+            print(f"[serve] slo: {slo_tracker.summary_line()}",
+                  file=sys.stderr)
         print(f"[serve] served {served} requests; stats: "
               f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)
         if out is not sys.stdout:

@@ -42,6 +42,7 @@ whole engine on the 8-fake-CPU test topology.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -51,7 +52,8 @@ from typing import Optional, Sequence
 import numpy as np
 
 from tpuic.runtime import faults as _faults
-from tpuic.serve.metrics import ServeStats
+from tpuic.serve.metrics import SPAN_PHASES, ServeStats
+from tpuic.telemetry.events import bus as _tm_bus
 from tpuic.telemetry.events import publish as _tm_publish
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
@@ -95,13 +97,21 @@ def make_forward(model, *, normalize: bool = False, mean=None, std=None):
 
 
 class _Request:
-    __slots__ = ("images", "n", "future", "t_enqueue")
+    """One submitted request plus its trace: a monotonically-assigned
+    trace id and the cumulative host-side timestamps the span ledger is
+    computed from (docs/observability.md, "Request tracing").  Stamps are
+    ``time.monotonic()`` reads — no device interaction, ever."""
 
-    def __init__(self, images: np.ndarray, future: Future) -> None:
+    __slots__ = ("images", "n", "future", "trace", "t_enqueue", "t_gather")
+
+    def __init__(self, images: np.ndarray, future: Future,
+                 trace: int = 0) -> None:
         self.images = images
         self.n = images.shape[0]
         self.future = future
+        self.trace = trace
         self.t_enqueue = time.monotonic()
+        self.t_gather = self.t_enqueue  # stamped when the batcher pops it
 
 
 class InferenceEngine:
@@ -154,6 +164,9 @@ class InferenceEngine:
         self._compile_lock = threading.Lock()
         self._jax = jax
         self.stats = stats if stats is not None else ServeStats()
+        # Request-scoped tracing: every submit gets the next trace id
+        # (itertools.count is safe under the GIL for concurrent callers).
+        self._traces = itertools.count(1)
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=max(1, int(queue_size)))
         self._held: Optional[_Request] = None
@@ -292,7 +305,10 @@ class InferenceEngine:
         if self._stop.is_set():
             raise RuntimeError("engine is closed")
         fut: Future = Future()
-        req = _Request(arr, fut)
+        req = _Request(arr, fut, trace=next(self._traces))
+        # Caller-side correlation handle: a driver logging an error line
+        # can name the same trace id the span ledger carries.
+        fut.tpuic_trace = req.trace
         try:
             if timeout == 0:
                 self._queue.put_nowait(req)
@@ -326,6 +342,10 @@ class InferenceEngine:
                 first = self._queue.get(timeout=idle_timeout)
             except queue.Empty:
                 return None
+            # Queue span ends when the batcher takes ownership; a held
+            # request keeps its ORIGINAL pop time — the wait while held
+            # belongs to batch formation, not the queue.
+            first.t_gather = time.monotonic()
         reqs, rows = [first], first.n
         deadline = time.monotonic() + self.max_wait
         while rows < self.max_batch:
@@ -336,6 +356,7 @@ class InferenceEngine:
                 nxt = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
+            nxt.t_gather = time.monotonic()
             if rows + nxt.n > self.max_batch:
                 self._held = nxt
                 break
@@ -355,6 +376,7 @@ class InferenceEngine:
         on ITS future and is dropped from the batch — siblings coalesced
         into the same device batch still dispatch and resolve. One bad
         request must never strand its batchmates (docs/robustness.md)."""
+        t_batch = time.monotonic()  # batch closed: formation span ends
         rows = sum(r.n for r in reqs)
         bucket = self.bucket_for(rows)
         if len(reqs) == 1 and reqs[0].n == bucket:
@@ -388,23 +410,38 @@ class InferenceEngine:
                 bucket = self.bucket_for(off)
                 batch = batch[:bucket]
                 rows = off
+        t_staged = time.monotonic()  # staging (pad/copy) span ends
         if _faults.fire("hang_device"):
             # 'hang_device' injection (runtime/faults.py): a stuck device
-            # call, for close()/drain-timeout tests.
+            # call, for close()/drain-timeout and perf-gate tests.
+            hang_s = _faults.param("hang_device")
+            # Explicit None check: '#0' must mean a 0 s stall (a
+            # severity-sweep control run), not the 1 s default.
             time.sleep(
-                float(_faults.param("hang_device") or 1.0))  # tpuic-ok: TPU101 fault param is a host float
-        now = time.monotonic()
+                1.0 if hang_s is None else float(hang_s))  # tpuic-ok: TPU101 fault param is a host float
         self.stats.record_dispatch(bucket, rows,
-                                   [now - r.t_enqueue for r in reqs])
+                                   [t_staged - r.t_enqueue for r in reqs])
         exe = self._executable_for(bucket)
         out = exe(self._variables, self._jax.device_put(batch))
-        return reqs, out, bucket
+        # Async dispatch: the call returns once work is ENQUEUED; the
+        # stamp closes the dispatch span, device time accrues until the
+        # readback in _resolve.
+        return reqs, out, bucket, (t_batch, t_staged, time.monotonic())
 
     def _resolve(self, inflight) -> None:
         """Block on device->host readback, slice per request, resolve
         futures.  Rows >= the batch's valid count are padding and are
-        never part of any slice."""
-        reqs, out, bucket = inflight
+        never part of any slice.
+
+        This is also where each request's span ledger closes
+        (docs/observability.md, "Request tracing"): the cumulative
+        timestamps stamped through submit -> gather -> dispatch plus the
+        readback/scatter stamps here become one ``serve_span`` event per
+        request whose phases sum to its end-to-end latency by
+        construction.  Everything is host-clock arithmetic — zero device
+        syncs and zero compiles added (checker-asserted in
+        tests/test_serve.py)."""
+        reqs, out, bucket, (t_batch, t_staged, t_dispatched) = inflight
         try:
             # Async-dispatch contract: device-side errors surface HERE,
             # not at dispatch — so this readback is also the error edge.
@@ -414,7 +451,7 @@ class InferenceEngine:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
             return
-        now = time.monotonic()
+        now = time.monotonic()  # device span ends: results are on host
         # Counters first: a caller woken by set_result may snapshot stats
         # immediately, and the batch it just completed must be in them.
         latencies = [now - r.t_enqueue for r in reqs]
@@ -426,6 +463,11 @@ class InferenceEngine:
         _tm_publish("serve_batch", bucket=int(bucket), requests=len(reqs),
                     images=int(valid),
                     latency_ms=round(1000.0 * max(latencies), 3))
+        # Span events are per REQUEST — only build the dicts when someone
+        # is listening (the bus's active() check keeps an unobserved
+        # engine free); the stats-side span meters always update (cheap
+        # deque appends feeding snapshot()/prom percentiles).
+        spans_live = _tm_bus.active("serve_span")
         off = 0
         for r in reqs:
             lo, hi = off, off + r.n
@@ -444,6 +486,21 @@ class InferenceEngine:
                     r.future.set_exception(e)
                 except BaseException:
                     pass  # future already done — nothing left to deliver
+            t_done = time.monotonic()  # scatter span ends: result delivered
+            spans = (r.t_gather - r.t_enqueue,   # queue
+                     t_batch - r.t_gather,       # batch formation
+                     t_staged - t_batch,         # staging pad/copy
+                     t_dispatched - t_staged,    # dispatch enqueue
+                     now - t_dispatched,         # device (+readback)
+                     t_done - now)               # result scatter
+            self.stats.record_spans(spans)
+            if spans_live:
+                data = {"trace": r.trace, "bucket": int(bucket),
+                        "rows": int(r.n), "batch_requests": len(reqs)}
+                for phase, s in zip(SPAN_PHASES, spans):
+                    data[f"{phase}_ms"] = round(1000.0 * s, 4)
+                data["total_ms"] = round(1000.0 * (t_done - r.t_enqueue), 4)
+                _tm_publish("serve_span", **data)
 
     def _run(self) -> None:
         inflight = None

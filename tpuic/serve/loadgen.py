@@ -1,0 +1,60 @@
+"""Shared load-generation harness for driving an InferenceEngine.
+
+One paced submission driver and one counter-settling wait, used by BOTH
+``bench_serve.py`` (closed-loop curves + the open-loop Poisson sweep)
+and the perf-regression gate (``tpuic.telemetry.regress``) — a fix to
+the pacing or settling logic lands in every consumer, so the gate and
+the benchmark cannot silently measure different things.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+
+def settle(stats, n: int, timeout_s: float = 2.0) -> dict:
+    """Wait (bounded) for ``stats`` to have recorded ``n`` requests,
+    then return the snapshot.
+
+    Futures resolve BEFORE the batcher's ``record_done`` runs, so a
+    caller that snapshots the instant its last result lands can be
+    short the final batch's counters."""
+    deadline = time.perf_counter() + timeout_s
+    while (stats.snapshot()["requests"] < n
+           and time.perf_counter() < deadline):
+        time.sleep(0.01)
+    return stats.snapshot()
+
+
+def run_stream(engine, reqs: Sequence, *,
+               offsets_s: Optional[Sequence[float]] = None,
+               result_timeout_s: float = 600.0) -> Tuple[float, float, dict]:
+    """Submit every request, wait for every result, settle the counters.
+
+    ``offsets_s[i]`` is request *i*'s target submit time relative to the
+    first submit — ``None`` offers the stream as fast as possible,
+    ``[i / rate ...]`` is a closed-loop paced curve, cumulative
+    exponential gaps make a Poisson open-loop arrival process.  The
+    driver never waits on results until the whole stream is submitted
+    (at deep saturation the engine's bounded queue blocks ``submit()``
+    itself, which shows up honestly as achieved < offered).
+
+    Returns ``(wall_s, arrival_s, snapshot)``: first submit -> last
+    result, first submit -> last submit, and the settled stats.
+    ``engine.stats`` is reset first, so ``snapshot["compiles"]`` is
+    exactly the executables built during this run."""
+    engine.stats.reset()
+    futs = [None] * len(reqs)
+    t0 = time.perf_counter()
+    for i, r in enumerate(reqs):
+        if offsets_s is not None:
+            delay = t0 + offsets_s[i] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        futs[i] = engine.submit(r)
+    arrival_s = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=result_timeout_s)
+    wall = time.perf_counter() - t0
+    return wall, arrival_s, settle(engine.stats, len(reqs))

@@ -29,8 +29,21 @@ from tpuic.metrics import LatencyMeter
 # Re-export shim: the percentile meter is owned by tpuic.metrics.meters
 # (ONE implementation shared by serve stats, the telemetry StepTimer,
 # and bench.py's per-step spread); ``from tpuic.serve.metrics import
-# LatencyMeter`` keeps working for existing callers.
-__all__ = ["LatencyMeter", "ServeStats"]
+# LatencyMeter`` keeps working for existing callers.  Percentiles are
+# nearest-rank, pinned and documented at tpuic.metrics.meters.quantile.
+__all__ = ["LatencyMeter", "ServeStats", "SPAN_PHASES"]
+
+# The request span ledger's phase order (docs/observability.md, "Request
+# tracing") — cumulative host timestamps through a request's life, so the
+# phases sum to the end-to-end latency by construction:
+#   queue    submit() -> batcher pops the request off the queue
+#   batch    popped -> batch closed (waiting for batchmates / held-over)
+#   staging  batch closed -> padded batch assembled (host gather/copy)
+#   dispatch staged -> executable call returned (async enqueue)
+#   device   dispatched -> device->host readback complete (includes the
+#            double-buffer wait behind the previous in-flight batch)
+#   scatter  readback -> this request's future resolved (slice + deliver)
+SPAN_PHASES = ("queue", "batch", "staging", "dispatch", "device", "scatter")
 
 
 class ServeStats:
@@ -45,6 +58,8 @@ class ServeStats:
         with self._lock:
             self.queue_wait = LatencyMeter(self._window)
             self.latency = LatencyMeter(self._window)
+            self.spans = {p: LatencyMeter(self._window)
+                          for p in SPAN_PHASES}
             self.batch_hist: Dict[int, int] = {}
             self.requests = 0
             self.images = 0
@@ -92,6 +107,12 @@ class ServeStats:
             for lat in latencies:
                 self.latency.update(lat)
 
+    def record_spans(self, spans) -> None:
+        """One request's span ledger (seconds, SPAN_PHASES order)."""
+        with self._lock:
+            for phase, s in zip(SPAN_PHASES, spans):
+                self.spans[phase].update(s)
+
     # -- reads ---------------------------------------------------------
     def pad_efficiency_rows(self) -> tuple:
         """(valid_rows, padded_rows) so far."""
@@ -110,6 +131,8 @@ class ServeStats:
                 "throughput_images_per_sec": round(self.images / elapsed, 2),
                 "queue_wait_ms": self.queue_wait.percentiles_ms(),
                 "latency_ms": self.latency.percentiles_ms(),
+                "span_ms": {p: m.percentiles_ms((50, 99))
+                            for p, m in self.spans.items() if m.count},
                 "batch_hist": {str(k): v for k, v in
                                sorted(self.batch_hist.items())},
                 "pad_efficiency": round(self.valid_rows / rows, 4)

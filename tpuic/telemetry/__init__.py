@@ -44,6 +44,8 @@ from tpuic.telemetry.events import (Event, EventBus, JsonlSink,  # noqa: F401
 from tpuic.telemetry.goodput import (GoodputTracker,  # noqa: F401
                                      PEAK_FLOPS, analytic_flops_per_step,
                                      peak_flops)
+from tpuic.telemetry.slo import (Objective, SLOTracker,  # noqa: F401
+                                 parse_objectives)
 from tpuic.telemetry.steptime import StepTimer  # noqa: F401
 from tpuic.telemetry.tracing import TraceTrigger  # noqa: F401
 
@@ -103,6 +105,15 @@ class TrainTelemetry:
         self.goodput = GoodputTracker(flops_per_step=flops, peak_flops=peak,
                                       global_batch=global_batch)
         self._unsubs.append(bus.subscribe(self.goodput.on_event))
+        # Step-time SLOs (telemetry/slo.py): attainment + error-budget
+        # burn over the 'step' events the StepTimer already publishes —
+        # one more host-side subscriber, nothing new on the hot path.
+        self.slo: Optional[SLOTracker] = None
+        slo_specs = getattr(run_cfg, "slo", "") or ""
+        if slo_specs:
+            self.slo = SLOTracker(parse_objectives(
+                slo_specs, allowed=("train_step",)))
+            self._unsubs.append(self.slo.attach(bus))
         trace_dir = os.environ.get("TPUIC_TRACE", "") or \
             getattr(run_cfg, "trace_dir", "") or ""
         self.tracer: Optional[TraceTrigger] = None
@@ -119,9 +130,14 @@ class TrainTelemetry:
                                               kinds=("step",)))
         if tb is not None:
             tbs = TensorBoardSink(tb)
+            # serve_batch/serve_span included: a train process never
+            # publishes them, but a process embedding both a Trainer and
+            # an InferenceEngine (predict-after-fit notebooks) gets its
+            # serve latencies as scalars through the same sink.
             self._unsubs.append(bus.subscribe(
                 tbs, kinds=("step", "skip", "rollback", "quarantine",
-                            "goodput")))
+                            "goodput", "restart", "slo", "serve_batch",
+                            "serve_span")))
 
     def flush(self) -> None:
         for s in self._sinks:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -41,7 +42,8 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 # stick to these; docs/observability.md is the schema reference.
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
-               "trace", "goodput", "restart", "heartbeat")
+               "serve_span", "slo", "trace", "goodput", "restart",
+               "heartbeat")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,19 +135,51 @@ class MemorySink:
 class JsonlSink:
     """One JSON line per event: ``{"event": kind, "t": ..., **data}``.
 
-    ``flush_every`` bounds buffered lines (1 = flush each event — the
-    default, so a killed process loses nothing; per-line flush of an
-    already-buffered file is microseconds against millisecond steps).
+    Durability ladder (the chaos soak used to tolerate torn tail lines a
+    SIGKILLed run simply lost; this sink stops losing them up front):
+
+    - ``flush_every`` bounds buffered lines (1 = flush each event — the
+      default, so a killed process loses nothing; per-line flush of an
+      already-buffered file is microseconds against millisecond steps).
+    - ``flush_interval_s`` bounds buffered *time* when ``flush_every``
+      is raised for very hot event streams: the first write after the
+      interval elapses flushes everything buffered.  The bound holds
+      while events keep flowing (the hot-stream case it exists for);
+      a stream that stops emitting holds its tail until the next
+      ``flush()``/``close()`` — which every drain path calls — because
+      the sink deliberately has no background timer thread.
+    - ``fsync=True`` additionally fsyncs at every flush — survives a
+      machine (not just process) kill; off by default, it is a real
+      per-event disk round trip.
+    - ``close()`` flushes (and fsyncs, if configured) before closing, so
+      a clean drain never leaves a torn tail; it is idempotent and
+      write-after-close is a no-op.
+
     Thread-safe: serve-thread and loop-thread events interleave whole
     lines, never bytes.
     """
 
-    def __init__(self, path: str, flush_every: int = 1) -> None:
+    def __init__(self, path: str, flush_every: int = 1,
+                 flush_interval_s: float = 0.5,
+                 fsync: bool = False) -> None:
         self.path = path
         self._fh = open(path, "a")
         self._lock = threading.Lock()
         self._since_flush = 0
         self._flush_every = max(1, int(flush_every))
+        self._flush_interval = max(0.0, float(flush_interval_s))
+        self._fsync = bool(fsync)
+        self._last_flush = time.monotonic()
+
+    def _flush_locked(self) -> None:
+        self._fh.flush()
+        if self._fsync:
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:
+                pass  # durability best-effort; never kill the loop
+        self._since_flush = 0
+        self._last_flush = time.monotonic()
 
     def __call__(self, ev: Event) -> None:
         rec = {"event": ev.kind, "t": round(ev.time, 6), **ev.data}
@@ -155,31 +189,36 @@ class JsonlSink:
                 return
             self._fh.write(line)
             self._since_flush += 1
-            if self._since_flush >= self._flush_every:
-                self._fh.flush()
-                self._since_flush = 0
+            if (self._since_flush >= self._flush_every
+                    or time.monotonic() - self._last_flush
+                    >= self._flush_interval):
+                self._flush_locked()
 
     def flush(self) -> None:
         with self._lock:
             if self._fh is not None:
-                self._fh.flush()
-                self._since_flush = 0
+                self._flush_locked()
 
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+                try:
+                    self._flush_locked()
+                finally:
+                    self._fh.close()
+                    self._fh = None
 
 
 class TensorBoardSink:
-    """Bus -> TensorBoard bridge: skip/rollback/quarantine counts and
-    goodput fractions become scalars instead of being log-only.
+    """Bus -> TensorBoard bridge: skip/rollback/quarantine counts,
+    goodput fractions, supervisor restarts, serve batch/span latencies,
+    and SLO attainment become scalars instead of being log-only.
 
     Wraps an existing ``tpuic.metrics.tensorboard.TensorBoardWriter``
     (the MetricLogger's); subscribes to ``step`` only to track the
     current global step so step-less events (quarantine fires in a
-    producer thread) land at a sensible x-coordinate.
+    producer thread) land at a sensible x-coordinate.  Serve events have
+    no train step at all, so they ride their own monotonic counters.
     """
 
     def __init__(self, writer) -> None:
@@ -187,6 +226,8 @@ class TensorBoardSink:
         self._step = 0
         self._quarantined = 0
         self._rollbacks = 0
+        self._serve_batches = 0
+        self._serve_spans = 0
 
     def __call__(self, ev: Event) -> None:
         if self._tb is None:
@@ -215,6 +256,40 @@ class TensorBoardSink:
                        if k.startswith("frac_")}
             if "mfu" in d and d["mfu"] is not None:
                 scalars["mfu"] = float(d["mfu"])
+            if scalars:
+                self._tb.scalars(int(d.get("step", self._step)), **scalars)
+        elif ev.kind == "restart":
+            # Supervisor restart (runtime/supervisor.py): the count and
+            # the downtime it cost, at the step the resumed run re-opened.
+            self._tb.scalars(self._step,
+                             restarts=float(d.get("restart", 0)),
+                             restart_downtime_s=float(
+                                 d.get("downtime_s", 0.0)))
+        elif ev.kind == "serve_batch":
+            self._serve_batches += 1
+            self._tb.scalars(self._serve_batches,
+                             serve_batch_latency_ms=float(
+                                 d.get("latency_ms", 0.0)),
+                             serve_batch_images=float(d.get("images", 0)),
+                             serve_batch_bucket=float(d.get("bucket", 0)))
+        elif ev.kind == "serve_span":
+            # One point per request: end-to-end latency plus the two
+            # spans that dominate tuning decisions (queue wait = load,
+            # device = model cost); the full ledger stays in JSONL.
+            self._serve_spans += 1
+            self._tb.scalars(self._serve_spans,
+                             serve_request_total_ms=float(
+                                 d.get("total_ms", 0.0)),
+                             serve_request_queue_ms=float(
+                                 d.get("queue_ms", 0.0)),
+                             serve_request_device_ms=float(
+                                 d.get("device_ms", 0.0)))
+        elif ev.kind == "slo":
+            name = str(d.get("name", "slo"))
+            scalars = {}
+            for field in ("attainment", "burn_rate", "budget_remaining"):
+                if d.get(field) is not None:
+                    scalars[f"slo_{name}_{field}"] = float(d[field])
             if scalars:
                 self._tb.scalars(int(d.get("step", self._step)), **scalars)
 

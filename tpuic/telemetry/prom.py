@@ -51,14 +51,47 @@ def render(rows: Iterable[Tuple], prefix: str = "tpuic") -> str:
     return "\n".join(out) + "\n" if out else ""
 
 
+def slo_rows(slo_report: Optional[dict]) -> List[Tuple]:
+    """SLOTracker.report() -> exposition rows (telemetry/slo.py): per
+    objective, the configured target/threshold plus rolling attainment,
+    error-budget burn rate, and remaining budget.  Shared by the serve
+    and train expositions; an empty/None report renders nothing."""
+    rows: List[Tuple] = []
+    for obj in (slo_report or {}).get("objectives", ()):
+        labels = {"slo": obj.get("name", "slo")}
+        for field, mtype, help_ in (
+                ("target", "gauge",
+                 "configured attainment target for this SLO"),
+                ("threshold_ms", "gauge",
+                 "latency threshold the SLO is measured against"),
+                ("samples", "counter",
+                 "samples observed in the rolling SLO window"),
+                ("attainment", "gauge",
+                 "rolling fraction of samples meeting the objective"),
+                ("current_ms", "gauge",
+                 "current value of the SLO's quantile over the window"),
+                ("burn_rate", "gauge",
+                 "error-budget burn rate (1.0 = burning exactly at "
+                 "budget; >1 = on track to exhaust it)"),
+                ("budget_remaining", "gauge",
+                 "fraction of the rolling error budget left (can go "
+                 "negative when the objective is blown)")):
+            if obj.get(field) is not None:
+                rows.append((f"slo_{field}", obj[field], mtype, help_,
+                             labels))
+    return rows
+
+
 def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
-                     heartbeat_age_s: Optional[float] = None) -> str:
+                     heartbeat_age_s: Optional[float] = None,
+                     slo: Optional[dict] = None) -> str:
     """ServeStats.snapshot() -> Prometheus text.
 
     ``heartbeat_age_s``: seconds since the supervised-liveness heartbeat
     file was last written (runtime/supervisor.py), when the server runs
     under ``python -m tpuic.supervise``; omitted (None) unsupervised —
-    a scraper alerting on staleness must not see a bogus 0."""
+    a scraper alerting on staleness must not see a bogus 0.
+    ``slo``: an SLOTracker.report() to append (telemetry/slo.py)."""
     rows: List[Tuple] = [
         ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
          "seconds since the liveness heartbeat file was last written "
@@ -93,20 +126,31 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
              "enqueue->result latency percentiles over the sliding window")):
         for q, v in (snapshot.get(src) or {}).items():
             rows.append((name, v, "gauge", help_, {"quantile": q}))
+    # Request span ledger percentiles (docs/observability.md, "Request
+    # tracing"): one series per phase of a request's life.
+    for phase, qs in (snapshot.get("span_ms") or {}).items():
+        for q, v in (qs or {}).items():
+            rows.append(("span_ms", v, "gauge",
+                         "per-request span percentiles by phase "
+                         "(queue/batch/staging/dispatch/device/scatter)",
+                         {"phase": phase, "quantile": q}))
     for bucket, n in (snapshot.get("batch_hist") or {}).items():
         rows.append(("batches_total", n, "counter",
                      "device calls per padding bucket", {"bucket": bucket}))
+    rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)
 
 
 def train_exposition(report: dict, steptime: Optional[dict] = None,
                      prefix: str = "tpuic_train",
-                     heartbeat_age_s: Optional[float] = None) -> str:
+                     heartbeat_age_s: Optional[float] = None,
+                     slo: Optional[dict] = None) -> str:
     """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text.
 
     ``heartbeat_age_s`` as in :func:`serve_exposition`; ``restart_count``
     comes from the report's ``restarts`` field (the supervisor restart
-    this process announced at fit() start — runtime/supervisor.py)."""
+    this process announced at fit() start — runtime/supervisor.py).
+    ``slo``: an SLOTracker.report() for the step-time objectives."""
     rows: List[Tuple] = [
         ("restart_count", report.get("restarts"), "counter",
          "supervisor restarts absorbed by this run "
@@ -139,6 +183,7 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
             rows.append((name, v, "gauge",
                          "step-time percentiles over the sliding window",
                          {"quantile": q}))
+    rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)
 
 

@@ -358,8 +358,11 @@ class Trainer:
                 # Injected host-side stall (runtime/faults.py): a
                 # deterministic step-time regression, for trace-trigger
                 # tests — the step's work is untouched.
+                slow_s = _faults.param("slow_step")
+                # Explicit None check: '#0' must mean a 0 s stall (a
+                # severity-sweep control run), not the default.
                 time.sleep(
-                    float(_faults.param("slow_step") or 0.05))  # tpuic-ok: TPU101 fault param is a host float
+                    0.05 if slow_s is None else float(slow_s))  # tpuic-ok: TPU101 fault param is a host float
             if _faults.fire("hard_crash", step=step0 + step):
                 # Abrupt process death: SIGKILL to self — no flush, no
                 # atexit, no Python teardown. The supervisor

@@ -204,6 +204,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "count, heartbeat age) to this file atomically at "
                         "every goodput report — the textfile-collector "
                         "transport, same as tpuic.serve's flag")
+    p.add_argument("--slo", default="",
+                   help="step-time SLOs, comma list of "
+                        "'train_step:pQ<=Nms[@target]' specs "
+                        "(telemetry/slo.py): rolling attainment and "
+                        "error-budget burn rate land in the metrics "
+                        "JSONL ('slo' events), TensorBoard, and the "
+                        "--prom-dump exposition")
     return p
 
 
@@ -219,6 +226,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
             raise SystemExit(
                 "train.py: error: --class-weights expects numbers or the "
                 f"single word 'auto' (got {args.class_weights!r})")
+    if args.slo:
+        # Validate the SLO grammar up front: a typo'd objective must fail
+        # the command line, not crash Trainer construction minutes later.
+        from tpuic.telemetry.slo import parse_objectives
+        try:
+            parse_objectives(args.slo, allowed=("train_step",))
+        except ValueError as e:
+            raise SystemExit(f"train.py: error: --slo: {e}")
     return Config(
         data=DataConfig(data_dir=args.datadir, resize_size=args.resize,
                         batch_size=args.batchsize, num_workers=args.workers,
@@ -263,7 +278,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       metrics_jsonl=args.metrics_jsonl,
                       trace_dir=args.trace_dir,
                       trace_threshold=args.trace_threshold,
-                      trace_steps=args.trace_steps),
+                      trace_steps=args.trace_steps,
+                      slo=args.slo),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
     )
@@ -316,10 +332,12 @@ def main(argv=None) -> int:
         if is_host0():
             def _prom_dump(ev) -> None:
                 hb = trainer.telemetry.heartbeat
+                slo = trainer.telemetry.slo
                 write_exposition(args.prom_dump, train_exposition(
                     dict(ev.data),
                     trainer.telemetry.steptime.summary(),
-                    heartbeat_age_s=hb.age_s() if hb is not None else None))
+                    heartbeat_age_s=hb.age_s() if hb is not None else None,
+                    slo=slo.report() if slo is not None else None))
             subscribe(_prom_dump, kinds=("goodput",))
     try:
         best = trainer.fit()
@@ -336,6 +354,8 @@ def main(argv=None) -> int:
         host0_print(f"[tpuic] preempted (flushed); best val accuracy "
                     f"{best:.4f}")
         return EXIT_PREEMPTED
+    if getattr(trainer.telemetry, "slo", None) is not None:
+        host0_print(f"[slo] {trainer.telemetry.slo.summary_line()}")
     host0_print(f"[tpuic] done; best val accuracy {best:.4f}")
     return 0
 
