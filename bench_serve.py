@@ -118,7 +118,7 @@ def _engine_run(engine, reqs, rate: float) -> dict:
 
 
 def _poisson_run(engine, reqs, rate: float, seed: int,
-                 grace_s: float) -> dict:
+                 grace_s: float, deadline_ms=None) -> dict:
     """Open-loop offered load: submissions follow a seeded Poisson
     process at ``rate`` req/s and never wait for results — the arrival
     process is independent of service, so queueing delay is *measured*,
@@ -129,21 +129,30 @@ def _poisson_run(engine, reqs, rate: float, seed: int,
     Saturation verdict: the backlog the run ends with.  After the last
     arrival, an engine that kept up drains within ~one service latency
     (``grace_s``); a backlog materially longer than that means requests
-    were queueing faster than they were served."""
+    were queueing faster than they were served.
+
+    ``deadline_ms`` attaches that latency budget to every request
+    (docs/serving.md, "Admission control and overload"): a request the
+    engine cannot serve inside it is shed at pop time instead of
+    queueing unboundedly, and the rung records the resulting
+    ``shed_rate`` — the overload-defense curve next to the latency
+    knee."""
     import numpy as np
 
     from tpuic.serve import loadgen
     rng = np.random.default_rng(seed)
+    items = (reqs if deadline_ms is None
+             else [(r, {"deadline_ms": deadline_ms}) for r in reqs])
     # Cumulative exponential gaps = a Poisson arrival process; handing
     # the shared driver precomputed offsets keeps arrivals independent
     # of service by construction.
     offsets = np.cumsum(rng.exponential(1.0 / rate, size=len(reqs)))
-    wall, arrival_s, snap = loadgen.run_stream(engine, reqs,
+    wall, arrival_s, snap = loadgen.run_stream(engine, items,
                                                offsets_s=offsets)
     backlog_s = wall - arrival_s
     return {
         "offered_req_per_sec": round(rate, 2),
-        "achieved_req_per_sec": round(len(reqs) / wall, 2),
+        "achieved_req_per_sec": round(snap["requests"] / wall, 2),
         "arrival_s": round(arrival_s, 3),
         "drain_backlog_s": round(backlog_s, 3),
         "saturated": bool(backlog_s > max(2.0 * grace_s,
@@ -154,6 +163,8 @@ def _poisson_run(engine, reqs, rate: float, seed: int,
         "pad_efficiency": snap["pad_efficiency"],
         "device_calls": snap["device_calls"],
         "compiles_during_run": snap["compiles"],
+        "shed": snap["rejected"],
+        "shed_rate": round(snap["rejected"] / max(1, len(reqs)), 4),
     }
 
 
@@ -173,24 +184,15 @@ def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
     backlog, see ``_poisson_run``) nor past ``knee_factor``x the
     lightest rung's p99 — the operating point admission control will
     defend."""
+    from tpuic.serve import loadgen
     reqs = _request_stream(n_req, 1, size, seed)  # 1 img/req: online case
-    probe_n = min(16, len(reqs))
-    engine.stats.reset()
-    t0 = time.perf_counter()
-    for r in reqs[:probe_n]:
-        engine.predict(r)
-    probe_raw_s = (time.perf_counter() - t0) / probe_n
-    # A sequential single-request predict() sits in batch formation for
-    # the full max_wait (empty queue, rows < max_batch) — a coalescing
-    # stall, not service.  The probe's own span ledger says exactly how
-    # long: strip the queue + batch-formation spans so the rate ladder
-    # anchors to true service time (with the default 5 ms max_wait and a
-    # ~2 ms forward, the raw probe would understate capacity ~3x and the
-    # sweep would never reach the saturation region it exists to map).
-    span = engine.stats.snapshot()["span_ms"]
-    stall_s = (span["queue"]["p50"] + span["batch"]["p50"]) / 1000.0
-    service_s = max(probe_raw_s - stall_s, 1e-6)
-    unbatched_rps = 1.0 / service_s
+    # The shared stall-stripped capacity probe (loadgen.py): with the
+    # default 5 ms max_wait and a ~2 ms forward, a raw sequential probe
+    # would understate capacity ~3x and the sweep would never reach the
+    # saturation region it exists to map.  Shared with the CI overload
+    # soak, so the gate and this benchmark anchor identically.
+    unbatched_rps, service_s, probe_raw_s, stall_s = \
+        loadgen.probe_unbatched_rps(engine, reqs)
     curve, knee = [], None
     for i, frac in enumerate(fractions):
         pt = _poisson_run(engine, reqs, max(1.0, frac * unbatched_rps),
@@ -207,6 +209,28 @@ def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
             # saturated ("highest load that STAYS unsaturated").
             break
         knee = pt
+    # Shed-rate curve (the admission layer's artifact, docs/serving.md):
+    # the SAME rate ladder with every request carrying the knee-derived
+    # latency budget (knee_factor x the lightest rung's p99 — the
+    # boundary the knee itself is defined by).  Below the knee sheds
+    # stay ~0; past it the engine sheds the unservable fraction at pop
+    # time instead of letting every request's latency grow without
+    # bound — overload becomes a shed percentage, not a collapse.
+    shed_deadline_ms = round(knee_factor * max(base_p99, 1.0), 3)
+    shed_curve = []
+    for i, frac in enumerate(fractions):
+        pt = _poisson_run(engine, reqs, max(1.0, frac * unbatched_rps),
+                          seed + 100 + i, grace_s=service_s,
+                          deadline_ms=shed_deadline_ms)
+        shed_curve.append({
+            "fraction_of_unbatched": frac,
+            "offered_req_per_sec": pt["offered_req_per_sec"],
+            "achieved_req_per_sec": pt["achieved_req_per_sec"],
+            "shed": pt["shed"],
+            "shed_rate": pt["shed_rate"],
+            "served_p99_ms": pt["latency_ms"].get("p99"),
+            "compiles_during_run": pt["compiles_during_run"],
+        })
     return {
         "mode": "poisson_open_loop",
         "requests_per_rate": n_req,
@@ -220,10 +244,16 @@ def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
                   "p99_ms": knee["latency_ms"].get("p99"),
                   "p50_ms": knee["latency_ms"].get("p50")}
                  if knee is not None else None),
+        "shed_deadline_ms": shed_deadline_ms,
+        "shed_curve": shed_curve,
         "note": ("knee = highest Poisson-offered load that stays "
                  "unsaturated (bounded end-of-run backlog) with p99 "
                  "within knee_factor x the lightest rung's p99; beyond "
-                 "it latency is queueing, not service"),
+                 "it latency is queueing, not service. shed_curve = the "
+                 "same ladder with per-request deadline_ms = "
+                 "shed_deadline_ms: past the knee the admission layer "
+                 "sheds the unservable fraction at pop time instead of "
+                 "letting latency grow without bound"),
     }
 
 
@@ -302,6 +332,8 @@ def main(argv=None) -> int:
     if open_loop is not None:
         steady_compiles += sum(pt["compiles_during_run"]
                                for pt in open_loop["curve"])
+        steady_compiles += sum(pt["compiles_during_run"]
+                               for pt in open_loop["shed_curve"])
     result = {
         "metric": "serve_images_per_sec_cpu_synthetic",
         "value": best["images_per_sec"],

@@ -1,0 +1,565 @@
+"""tpuic.serve.admission: priority classes, deadline shedding, quotas,
+brownout (docs/serving.md, "Admission control and overload").
+
+The overload contract under test: under contention high-priority
+requests are batched first (and evict lower classes from a full queue),
+an expired deadline sheds at pop time with a typed ``DeadlineExceeded``
+while its batchmates resolve untouched (the PR-2 isolation discipline),
+token buckets refill at exactly their configured rate, brownout
+tightens immediately and recovers hysteretically — and none of it adds
+a single device sync or compile (checker-asserted, the PR-3/PR-6
+discipline).  All CPU tier-1.
+"""
+
+import json
+import queue as _queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.serve import InferenceEngine
+from tpuic.serve.admission import (PRIORITIES, AdmissionController,
+                                   AdmissionError, AdmissionRejected,
+                                   BrownoutController, DeadlineExceeded,
+                                   TokenBucket, parse_quotas,
+                                   priority_index)
+
+SIZE = 4
+
+
+def _sum_forward(variables, images):
+    s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+    return s + variables["bias"]
+
+
+def _engine(**kw):
+    kw.setdefault("forward_fn", _sum_forward)
+    kw.setdefault("variables", {"bias": jnp.float32(0.0)})
+    kw.setdefault("image_size", SIZE)
+    kw.setdefault("buckets", (1, 2, 4))
+    return InferenceEngine(**kw)
+
+
+def _imgs(rng, n=1):
+    return rng.standard_normal((n, SIZE, SIZE, 3)).astype(np.float32)
+
+
+class _Clock:
+    """Deterministic monotonic clock for token-bucket math."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- vocabulary / parsing ----------------------------------------------------
+def test_priority_vocabulary():
+    assert PRIORITIES == ("high", "normal", "low")
+    assert [priority_index(p) for p in PRIORITIES] == [0, 1, 2]
+    with pytest.raises(ValueError, match="unknown priority"):
+        priority_index("urgent")
+
+
+def test_parse_quotas():
+    assert parse_quotas(["a=10", "*=5"]) == {"a": 10.0, "*": 5.0}
+    assert parse_quotas("a=10,b=2.5") == {"a": 10.0, "b": 2.5}
+    assert parse_quotas([]) == {}
+    for bad in ("a", "a=", "a=0", "a=-1", "=5", "a=x"):
+        with pytest.raises(ValueError, match="bad quota spec"):
+            parse_quotas([bad])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_quotas(["a=1", "a=2"])
+
+
+# -- token bucket ------------------------------------------------------------
+def test_token_bucket_refill_math():
+    clk = _Clock()
+    b = TokenBucket(10.0, burst=5.0, clock=clk)
+    # starts full at burst capacity
+    assert all(b.try_take() for _ in range(5))
+    assert not b.try_take()          # dry, and a failed take takes nothing
+    clk.advance(0.3)                 # 0.3 s * 10/s = 3 tokens back
+    assert all(b.try_take() for _ in range(3))
+    assert not b.try_take()
+    clk.advance(100.0)               # refill is capped at burst
+    assert b.tokens <= 5.0 or b.try_take()
+    taken = sum(b.try_take() for _ in range(10))
+    assert taken == 5                # exactly burst, not 1000
+    with pytest.raises(ValueError, match="rate"):
+        TokenBucket(0.0)
+
+
+def test_token_bucket_sustains_exact_rate():
+    clk = _Clock()
+    b = TokenBucket(4.0, burst=1.0, clock=clk)
+    granted = 0
+    for _ in range(40):              # 10 simulated seconds at 10 Hz polls
+        clk.advance(0.25)
+        granted += b.try_take()
+    assert granted == 40 * 0.25 * 4.0 / 1.0  # = rate * time = 40... capped
+    # 4 tokens/s for 10 s = 40 grants offered 40 polls -> all granted
+    clk2 = _Clock()
+    b2 = TokenBucket(2.0, burst=1.0, clock=clk2)
+    granted2 = 0
+    for _ in range(100):             # oversubscribed: poll at 10 Hz
+        clk2.advance(0.1)
+        granted2 += b2.try_take()
+    # ~rate * time grants, and NEVER an overrun (float slop may under-
+    # grant a poll or two; it must not mint tokens)
+    assert 17 <= granted2 <= 2.0 * 10.0 + 1
+
+
+# -- controller: quotas + free pool ------------------------------------------
+def test_quota_with_shared_free_pool():
+    clk = _Clock()
+    ctl = AdmissionController(parse_quotas(["a=2", "*=1"]), clock=clk)
+    # tenant a: burst max(1, 2) = 2 own tokens, then borrows the pool
+    assert ctl.admit(tenant="a")
+    assert ctl.admit(tenant="a")
+    assert ctl.admit(tenant="a")     # pool token
+    v = ctl.admit(tenant="a")
+    assert not v and v.cause == "quota"
+    # unconfigured tenant rides the pool only — which a just drained
+    v2 = ctl.admit(tenant="zzz")
+    assert not v2 and v2.cause == "quota"
+    clk.advance(1.0)                 # pool refills at 1/s
+    assert ctl.admit(tenant="zzz")
+    # no pool configured -> unconfigured tenants are unlimited
+    ctl2 = AdmissionController(parse_quotas(["a=1"]), clock=clk)
+    assert all(ctl2.admit(tenant=None) for _ in range(50))
+    state = ctl.state()
+    assert "a" in state["tenant_tokens"]
+    assert state["free_pool_tokens"] is not None
+    json.dumps(state)
+    # state() refills before reading: a dry bucket with no traffic
+    # since must not scrape as permanently out of quota
+    clk.advance(100.0)
+    refreshed = ctl.state()
+    assert refreshed["tenant_tokens"]["a"] == 2.0  # back at burst
+    assert refreshed["free_pool_tokens"] == 1.0
+
+
+# -- brownout state machine --------------------------------------------------
+def test_brownout_tighten_and_hysteretic_recovery():
+    events = []
+    bo = BrownoutController("slo_x", tighten_above=2.0, recover_below=1.0,
+                            recover_after=3,
+                            publish=lambda kind, **d: events.append((kind, d)))
+    assert bo.level == 0 and not bo.sheds("low")
+    bo.observe(3.0)                  # tighten one class per bad report
+    assert bo.level == 1
+    assert bo.sheds("low") and not bo.sheds("normal")
+    bo.observe(2.0)                  # >= threshold is inclusive
+    assert bo.level == 2
+    assert bo.sheds("normal") and not bo.sheds("high")
+    bo.observe(9.0)                  # max_level: high is NEVER shed
+    assert bo.level == 2 and not bo.sheds("high")
+    # recovery needs recover_after CONSECUTIVE good reports
+    bo.observe(0.5)
+    bo.observe(0.5)
+    assert bo.level == 2
+    bo.observe(1.5)                  # hysteresis band: streak resets
+    bo.observe(0.5)
+    bo.observe(0.5)
+    assert bo.level == 2
+    bo.observe(0.5)                  # third consecutive -> one level back
+    assert bo.level == 1
+    kinds = [d["action"] for _, d in events]
+    assert kinds == ["tighten", "tighten", "recover"]
+    assert all(k == "admission" for k, _ in events)
+    assert events[-1][1]["level"] == 1 and events[-1][1]["slo"] == "slo_x"
+    with pytest.raises(ValueError, match="hysteresis"):
+        BrownoutController("x", tighten_above=1.0, recover_below=2.0)
+
+
+def test_brownout_rides_the_slo_bus():
+    """End-to-end coupling: slo events on the bus (what SLOTracker
+    publishes every publish_every samples) drive the level; foreign
+    objectives and sample-less reports are ignored."""
+    from tpuic.telemetry.events import MemorySink, bus
+
+    ms = MemorySink()
+    unsub_ms = bus.subscribe(ms, kinds=("admission",))
+    bo = BrownoutController("serve_latency_p99", tighten_above=2.0)
+    unsub = bo.attach(bus)
+    try:
+        bus.publish("slo", name="other_objective", burn_rate=99.0)
+        assert bo.level == 0
+        bus.publish("slo", name="serve_latency_p99", burn_rate=None)
+        assert bo.level == 0
+        bus.publish("slo", name="serve_latency_p99", burn_rate=5.0)
+        assert bo.level == 1
+    finally:
+        unsub()
+        unsub_ms()
+    evs = ms.of("admission")
+    assert len(evs) == 1
+    assert evs[0].data["action"] == "tighten"
+    assert "low" in evs[0].data["sheds"]
+
+
+def test_brownout_sheds_through_the_engine():
+    """A browned-out controller rejects low-priority submits with a
+    typed brownout verdict while high passes — the submit-time path."""
+    bo = BrownoutController("x")
+    bo.observe(5.0)                  # level 1: sheds low
+    ctl = AdmissionController(brownout=bo)
+    eng = _engine(admission=ctl, max_wait_ms=0.0)
+    try:
+        rng = np.random.default_rng(0)
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(_imgs(rng), priority="low")
+        assert ei.value.cause == "brownout" and ei.value.priority == "low"
+        out = eng.predict(_imgs(rng), timeout=30)  # normal still admitted
+        assert out.shape == (1,)
+    finally:
+        eng.close()
+    snap = eng.stats.snapshot()
+    assert snap["rejected_by"] == {"brownout": {"low": 1}}
+
+
+# -- engine: priority-class queuing ------------------------------------------
+def test_priority_ordering_under_contention():
+    """Queued low-priority work must not be batched ahead of queued
+    high-priority work: with both classes waiting, the first device
+    batch is all-high."""
+    eng = _engine(autostart=False, max_wait_ms=50.0)
+    eng.warmup()
+    rng = np.random.default_rng(1)
+    done_order = []
+    lock = threading.Lock()
+
+    def track(tag):
+        def cb(_f):
+            with lock:
+                done_order.append(tag)
+        return cb
+
+    for i in range(4):
+        eng.submit(_imgs(rng), priority="low").add_done_callback(
+            track("low"))
+    for i in range(4):
+        eng.submit(_imgs(rng), priority="high").add_done_callback(
+            track("high"))
+    eng.start()
+    deadline = time.monotonic() + 30
+    while len(done_order) < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    eng.close()
+    assert done_order[:4] == ["high"] * 4, done_order
+    assert done_order[4:] == ["low"] * 4, done_order
+
+
+def test_full_queue_evicts_lowest_priority():
+    """A full queue admits a strictly-higher-priority arrival by
+    evicting the YOUNGEST lowest-class request (typed queue_full verdict
+    on the victim's future); same-class arrivals still get the plain
+    bounded-queue behavior."""
+    eng = _engine(queue_size=2, autostart=False)
+    rng = np.random.default_rng(2)
+    low1 = eng.submit(_imgs(rng), priority="low")
+    low2 = eng.submit(_imgs(rng), priority="low")
+    # same class: no eviction, stdlib backpressure semantics
+    with pytest.raises(_queue.Full):
+        eng.submit(_imgs(rng), priority="low", timeout=0)
+    # higher class: admitted at the youngest low request's expense
+    high = eng.submit(_imgs(rng), priority="high", timeout=0)
+    with pytest.raises(AdmissionRejected) as ei:
+        low2.result(timeout=1)
+    assert ei.value.cause == "queue_full" and ei.value.priority == "low"
+    assert isinstance(ei.value, _queue.Full)  # old handlers keep working
+    eng.start()
+    assert high.result(timeout=30).shape == (1,)
+    assert low1.result(timeout=30).shape == (1,)
+    eng.close()
+    snap = eng.stats.snapshot()
+    assert snap["rejected"] == 2
+    assert snap["rejected_by"]["queue_full"]["low"] == 2
+
+
+# -- engine: deadline shedding -----------------------------------------------
+def test_expired_deadline_sheds_at_pop_batchmates_unaffected():
+    """The shed happens at pop time, BEFORE batch membership: the
+    expired request's future gets DeadlineExceeded, its would-be
+    batchmates dispatch and resolve normally (PR-2 isolation)."""
+    eng = _engine(autostart=False, max_wait_ms=0.0)
+    eng.warmup()
+    rng = np.random.default_rng(3)
+    doomed = eng.submit(_imgs(rng), deadline_ms=1.0, priority="normal")
+    healthy = [eng.submit(_imgs(rng)) for _ in range(3)]
+    time.sleep(0.05)                 # let the deadline expire while queued
+    eng.start()
+    with pytest.raises(DeadlineExceeded) as ei:
+        doomed.result(timeout=30)
+    assert ei.value.cause == "deadline"
+    for f in healthy:
+        assert f.result(timeout=30).shape == (1,)
+    eng.close()
+    snap = eng.stats.snapshot()
+    assert snap["rejected_by"] == {"deadline": {"normal": 1}}
+    assert snap["requests"] == 3     # sheds never count as served
+
+
+def test_generous_deadline_not_shed():
+    eng = _engine(max_wait_ms=0.0)
+    try:
+        rng = np.random.default_rng(4)
+        out = eng.submit(_imgs(rng), deadline_ms=60_000.0).result(timeout=30)
+        assert out.shape == (1,)
+    finally:
+        eng.close()
+    assert eng.stats.snapshot()["rejected"] == 0
+
+
+def test_estimated_service_feeds_the_shedder():
+    """After traffic, the span ledger yields a positive service
+    estimate; a queued request whose deadline is inside that estimate
+    sheds even though the deadline has not yet expired at pop time."""
+    eng = _engine(max_wait_ms=0.0)
+    rng = np.random.default_rng(5)
+    for _ in range(6):
+        eng.predict(_imgs(rng), timeout=30)
+    est = eng.stats.estimated_service_s()
+    assert est > 0.0
+    # deadline strictly between "now" and "now + est": only the
+    # estimate-aware check can shed it
+    eng._stop.set()                  # pause intake
+    eng._thread.join(timeout=10)
+    eng._stop.clear()
+    doomed = eng.submit(_imgs(rng), deadline_ms=max(0.1, est * 1e3 / 4))
+    eng.start()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    eng.close()
+
+
+# -- engine: typed quota verdicts --------------------------------------------
+def test_quota_rejection_through_the_engine():
+    clk = _Clock()
+    ctl = AdmissionController(parse_quotas(["t1=1"]), clock=clk)
+    eng = _engine(admission=ctl, max_wait_ms=0.0)
+    try:
+        rng = np.random.default_rng(6)
+        ok = eng.submit(_imgs(rng), tenant="t1")
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(_imgs(rng), tenant="t1")
+        assert ei.value.cause == "quota" and ei.value.tenant == "t1"
+        assert ok.result(timeout=30).shape == (1,)
+        clk.advance(1.0)             # bucket refills -> admitted again
+        assert eng.predict(_imgs(rng), timeout=30).shape == (1,)
+    finally:
+        eng.close()
+    assert eng.stats.snapshot()["rejected_by"] == {"quota": {"normal": 1}}
+
+
+# -- ledger + exposition -----------------------------------------------------
+def test_run_stream_ledger_is_exact():
+    """The loadgen invariant: every offered item either resolves
+    (requests) or is rejected/shed under exactly one cause —
+    accepted + rejected == offered, no double counting, no silent drops."""
+    from tpuic.serve import loadgen
+
+    eng = _engine(max_wait_ms=0.0)
+    eng.warmup()
+    rng = np.random.default_rng(7)
+    items = []
+    for i in range(12):
+        if i % 4 == 0:   # these shed: already-expired deadline
+            items.append((_imgs(rng), {"deadline_ms": 0.0}))
+        elif i % 4 == 1:
+            items.append((_imgs(rng), {"priority": "high"}))
+        else:
+            items.append(_imgs(rng))
+    wall, _, snap = loadgen.run_stream(eng, items)
+    eng.close()
+    assert snap["requests"] + snap["rejected"] == len(items)
+    assert snap["rejected_by"].get("deadline", {}).get("normal", 0) == 3
+
+
+def test_run_stream_counts_bare_queue_full_and_reports_outcomes():
+    """A controller-less engine rejects with BARE queue.Full — the
+    shared driver must count it as that item's outcome (not crash the
+    drive), and the on_done hook must report every item exactly once
+    with its verdict."""
+    from tpuic.serve import loadgen
+
+    eng = _engine(autostart=False, queue_size=1, max_wait_ms=0.0)
+    eng.warmup()
+    rng = np.random.default_rng(12)
+    items = [(_imgs(rng), {"timeout": 0}) for _ in range(3)]
+    outcomes = []
+    lock = threading.Lock()
+
+    def on_done(i, ok, latency_s):
+        with lock:
+            outcomes.append((i, ok, latency_s))
+
+    # queue_size=1: item 0 queues, 1 and 2 reject at submit; the
+    # batcher starts mid-drive and resolves item 0.
+    threading.Timer(0.2, eng.start).start()
+    _, _, snap = loadgen.run_stream(eng, items, on_done=on_done)
+    eng.close()
+    assert snap["requests"] + snap["rejected"] == len(items)
+    assert snap["rejected_by"] == {"queue_full": {"normal": 2}}
+    assert {(i, ok) for i, ok, _ in outcomes} == {(0, True), (1, False),
+                                                  (2, False)}
+    lat = [s for i, ok, s in outcomes if ok]
+    assert len(lat) == 1 and lat[0] > 0
+    assert all(s is None for i, ok, s in outcomes if not ok)
+
+
+def test_prom_exposition_splits_rejects_and_shows_brownout():
+    from tpuic.telemetry.prom import serve_exposition
+
+    eng = _engine(autostart=False, queue_size=1)
+    rng = np.random.default_rng(8)
+    keep = eng.submit(_imgs(rng), priority="low")
+    eng.submit(_imgs(rng), priority="high", timeout=0)  # evicts keep
+    with pytest.raises(AdmissionError):
+        keep.result(timeout=1)
+    bo = BrownoutController("slo_y")
+    bo.observe(5.0)
+    ctl = AdmissionController(parse_quotas(["a=7"]), brownout=bo)
+    text = serve_exposition(eng.stats.snapshot(), admission=ctl.state())
+    eng.close()
+    assert ('tpuic_serve_rejected_total{cause="queue_full",'
+            'priority="low"} 1') in text
+    assert 'tpuic_serve_brownout_level{slo="slo_y"} 1' in text
+    assert 'tpuic_serve_quota_tokens{tenant="a"} 7' in text
+    # the old unlabeled series is gone — the split replaced it
+    assert not any(ln.startswith("tpuic_serve_rejected_total ")
+                   for ln in text.splitlines())
+
+
+def test_snapshot_jsonable_with_admission_fields():
+    eng = _engine(autostart=False)
+    eng.stats.record_reject("brownout", "low")
+    eng.stats.record_reject("deadline", "normal")
+    snap = eng.stats.snapshot()
+    json.dumps(snap)
+    assert snap["rejected"] == 2
+    assert snap["rejected_by"] == {"brownout": {"low": 1},
+                                   "deadline": {"normal": 1}}
+    eng.close()
+
+
+# -- the CLI driver end to end -----------------------------------------------
+def test_serve_main_admission_flags_and_flood(tmp_path, monkeypatch, capsys):
+    """``python -m tpuic.serve --admission --quota`` end to end with the
+    checkpoint load stubbed: SLA fields ride the request lines, a dry
+    quota becomes a typed error line (cause labeled), the 'flood' fault
+    point storms from inside the driver, and the exit summary carries
+    the [admission] attribution line."""
+    from PIL import Image
+
+    import tpuic.serve.__main__ as serve_main
+    from tpuic.runtime import faults
+
+    img_path = tmp_path / "im.png"
+    rng = np.random.default_rng(11)
+    Image.fromarray(rng.integers(0, 256, (SIZE, SIZE, 3),
+                                 np.uint8)).save(img_path)
+
+    def fake_build_engine(args):
+        def fwd(variables, images):
+            s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+            probs = jax.nn.softmax(
+                jnp.stack([s, -s], axis=-1), axis=-1)
+            return probs, jnp.argsort(-probs, axis=-1)
+        eng = InferenceEngine(forward_fn=fwd, variables={},
+                              image_size=SIZE, input_dtype=np.uint8,
+                              buckets=(1, 2, 4), max_wait_ms=2.0)
+        eng.warmup()
+        return eng, SIZE, 2, "stub"
+
+    monkeypatch.setattr(serve_main, "build_engine", fake_build_engine)
+    lines = [
+        json.dumps({"id": "hi", "path": str(img_path),
+                    "priority": "high", "deadline_ms": 60000,
+                    "tenant": "t1"}),
+        json.dumps({"id": "quota'd", "path": str(img_path),
+                    "tenant": "capped"}),
+        json.dumps({"id": "quota'd-2", "path": str(img_path),
+                    "tenant": "capped"}),
+        json.dumps({"id": "typo", "path": str(img_path),
+                    "priority": "urgent"}),
+    ]
+    monkeypatch.setattr(serve_main.sys, "stdin",
+                        __import__("io").StringIO("\n".join(lines) + "\n"))
+    faults.reset()
+    faults.arm("flood", param=200.0)
+    out = tmp_path / "resp.jsonl"
+    try:
+        rc = serve_main.main(["--out", str(out), "--num-classes", "2",
+                              "--quota", "capped=1"])
+    finally:
+        faults.reset()
+    assert rc == 0
+    got = {}
+    for ln in out.read_text().splitlines():
+        rec = json.loads(ln)
+        got[rec["id"]] = rec
+    assert got["hi"]["pred"] in {"0", "1"}
+    # one of the two capped-tenant requests ran on its single burst
+    # token; the other got the typed quota verdict
+    quota_errs = [r for r in (got["quota'd"], got["quota'd-2"])
+                  if "error" in r]
+    assert len(quota_errs) == 1 and quota_errs[0]["cause"] == "quota"
+    assert "unknown priority" in got["typo"]["error"]
+    err = capsys.readouterr().err
+    assert "fault 'flood' armed" in err
+    assert "[admission]" in err and "rejected_by" in err
+
+
+# -- the zero-cost contract --------------------------------------------------
+def test_admission_adds_zero_syncs_zero_compiles():
+    """The acceptance contract (ISSUE 7): admission is host-side
+    arithmetic — the compile counter stays flat after warmup and the
+    jax.device_get count is IDENTICAL with the full admission feature
+    set on vs. a bare engine driving the same stream."""
+    from tpuic.analysis.runtime import (assert_compiles_flat,
+                                        count_device_gets)
+
+    def stream(eng, seed, sla):
+        rng = np.random.default_rng(seed)
+        futs = []
+        for i in range(12):
+            kw = {}
+            if sla:
+                kw = {"priority": PRIORITIES[i % 3],
+                      "deadline_ms": 60_000.0,
+                      "tenant": "t"}
+            futs.append(eng.submit(_imgs(rng, 1 + i % 2), **kw))
+        for f in futs:
+            f.result(timeout=30)
+
+    bare = _engine(max_wait_ms=1.0)
+    try:
+        bare.warmup()
+        with count_device_gets() as gets_off:
+            stream(bare, 9, sla=False)
+    finally:
+        bare.close()
+
+    ctl = AdmissionController(parse_quotas(["t=10000", "*=10000"]),
+                              brownout=BrownoutController("x"))
+    eng = _engine(max_wait_ms=1.0, admission=ctl)
+    try:
+        eng.warmup()
+        with assert_compiles_flat(0, what="admission-controlled stream"):
+            with count_device_gets() as gets_on:
+                stream(eng, 9, sla=True)
+    finally:
+        eng.close()
+    assert gets_on.count == gets_off.count
+    assert eng.stats.snapshot()["compiles"] == len(eng.buckets)
+    assert eng.stats.snapshot()["rejected"] == 0

@@ -117,6 +117,14 @@ class LatencyMeter:
         self.count += 1
         self.total += s
 
+    def quantile_s(self, q: float):
+        """One nearest-rank quantile in SECONDS over the window (None
+        when no samples yet — callers needing an estimate must not read
+        a fabricated 0 as "instant")."""
+        if not self._win:
+            return None
+        return quantile(self._win, q)
+
     def percentiles_ms(self, qs=(50, 95, 99, 99.9)) -> dict:
         """{'p50': ms, ..., 'p999': ms} over the window (nearest-rank,
         see :func:`quantile`); {} when no samples yet."""

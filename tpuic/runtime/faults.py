@@ -40,6 +40,14 @@ Registered points (site → meaning of ``step``):
                       global step (sleep ``param`` seconds; forever
                       without a payload) — a wedged device call / data
                       deadlock for the supervisor's heartbeat watchdog.
+- ``flood``         — serve driver (serve/__main__.py): a synthetic
+                      low-priority request storm submitted from inside
+                      the process at ``param`` requests/sec (default 50)
+                      for the life of the server — reproducible overload
+                      for the admission-control layer (docs/serving.md):
+                      ``TPUIC_FAULTS='flood#200'`` drives the engine
+                      past its knee with traffic the brownout/priority
+                      machinery is supposed to shed.
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
@@ -80,7 +88,7 @@ __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
 # read as "the system survived the fault" when no fault happened).
 REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
-    "slow_step", "hard_crash", "hang_step",
+    "slow_step", "hard_crash", "hang_step", "flood",
 })
 
 

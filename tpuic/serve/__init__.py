@@ -17,6 +17,10 @@ latency/throughput accounting.
 (tpuic/serve/__main__.py) — no network dependency.
 """
 
+from tpuic.serve.admission import (PRIORITIES, AdmissionController,  # noqa: F401
+                                   AdmissionError, AdmissionRejected,
+                                   BrownoutController, DeadlineExceeded,
+                                   TokenBucket, parse_quotas)
 from tpuic.serve.engine import (DEFAULT_BUCKETS, InferenceEngine,  # noqa: F401
                                 default_buckets, make_forward)
 from tpuic.serve.metrics import ServeStats  # noqa: F401

@@ -41,6 +41,8 @@ from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as np
 
+from tpuic.serve.admission import AdmissionError  # stdlib-only import
+
 
 def _load_image(path: str, size: int) -> np.ndarray:
     """Decode + resize EXACTLY like the training/predict pipeline
@@ -199,7 +201,38 @@ def main(argv=None) -> int:
                         "what switches per-request span events on; "
                         "attainment and error-budget burn land in the "
                         "Prometheus exposition and the final stats line")
+    p.add_argument("--admission", action="store_true",
+                   help="SLA-aware admission control (docs/serving.md): "
+                        "request lines may carry priority/deadline_ms/"
+                        "tenant; a full queue rejects with a typed, "
+                        "cause-labeled error line instead of blocking "
+                        "the accept loop, higher priority classes are "
+                        "batched first (and evict lower ones from a "
+                        "full queue), and expired deadlines shed at "
+                        "pop time")
+    p.add_argument("--quota", action="append", default=[],
+                   metavar="TENANT=RPS",
+                   help="per-tenant token-bucket quota in requests/sec "
+                        "(repeatable, or one comma list); '*=RPS' sets "
+                        "the shared free pool unconfigured tenants and "
+                        "dry tenant buckets draw from. Implies "
+                        "--admission")
+    p.add_argument("--brownout-slo", default="",
+                   help="name of one --slo objective (e.g. "
+                        "serve_latency_p99) whose error-budget burn "
+                        "rate drives brownout: past --brownout-tighten "
+                        "the controller sheds one priority class per "
+                        "SLO report, recovering hysteretically below "
+                        "--brownout-recover. Implies --admission")
+    p.add_argument("--brownout-tighten", type=float, default=2.0,
+                   help="burn rate at/above which brownout tightens "
+                        "one level")
+    p.add_argument("--brownout-recover", type=float, default=1.0,
+                   help="burn rate at/below which (after 3 consecutive "
+                        "reports) brownout relaxes one level")
     args = p.parse_args(argv)
+    if args.quota or args.brownout_slo:
+        args.admission = True
 
     slo_tracker = None
     if args.slo:
@@ -211,6 +244,33 @@ def main(argv=None) -> int:
                 args.slo, allowed=("serve_latency",)))
         except ValueError as e:
             raise SystemExit(f"serve: --slo: {e}")
+
+    # Admission config parses up front too (same fail-fast rule): a
+    # typo'd quota would read as "unlimited" exactly when you meant to
+    # cap someone, and a brownout coupled to an objective --slo never
+    # tracks would silently never tighten.
+    admission_ctl = None
+    if args.admission:
+        from tpuic.serve.admission import (AdmissionController,
+                                           BrownoutController, parse_quotas)
+        try:
+            quotas = parse_quotas(args.quota)
+        except ValueError as e:
+            raise SystemExit(f"serve: --quota: {e}")
+        brownout = None
+        if args.brownout_slo:
+            known = ([o.name for o in slo_tracker.objectives]
+                     if slo_tracker is not None else [])
+            if args.brownout_slo not in known:
+                raise SystemExit(
+                    f"serve: --brownout-slo {args.brownout_slo!r} names "
+                    f"no --slo objective (configured: "
+                    f"{', '.join(known) or 'none'}) — brownout would "
+                    "never see a burn rate")
+            brownout = BrownoutController(
+                args.brownout_slo, tighten_above=args.brownout_tighten,
+                recover_below=args.brownout_recover)
+        admission_ctl = AdmissionController(quotas, brownout=brownout)
 
     # Install the latch BEFORE the (potentially minutes-long) checkpoint
     # load + AOT warmup: an eviction during startup must also exit
@@ -258,13 +318,28 @@ def main(argv=None) -> int:
         from tpuic.telemetry.events import bus as _slo_bus
         slo_tracker.attach(_slo_bus)
 
+    if admission_ctl is not None:
+        # Post-build attach (engine.admission is a public, settable
+        # field): submit() now consults brownout + quotas up front.
+        engine.admission = admission_ctl
+        if admission_ctl.brownout is not None:
+            # Brownout rides the same bus the SLO tracker publishes its
+            # periodic reports on; its tighten/recover transitions come
+            # back as 'admission' events (JSONL/TensorBoard sinks).
+            from tpuic.telemetry.events import bus as _adm_bus
+            admission_ctl.brownout.attach(_adm_bus)
+        print(f"[serve] admission control on: "
+              f"{json.dumps(admission_ctl.state())}", file=sys.stderr)
+
     def _prom_text() -> str:
         return serve_exposition(
             engine.stats.snapshot(),
             heartbeat_age_s=(heartbeat.age_s() if heartbeat is not None
                              else None),
             slo=(slo_tracker.report() if slo_tracker is not None
-                 else None))
+                 else None),
+            admission=(admission_ctl.state() if admission_ctl is not None
+                       else None))
 
     prom_server = None
     if args.prom_port:
@@ -272,6 +347,37 @@ def main(argv=None) -> int:
                                  host=args.prom_host)
         print(f"[serve] prometheus /metrics on "
               f"{args.prom_host}:{prom_server.port}", file=sys.stderr)
+    # 'flood' injection point (runtime/faults.py): a synthetic
+    # low-priority request storm from inside the process, at #PARAM
+    # req/s — reproducible overload under the TPUIC_FAULTS grammar, so
+    # the admission layer's shedding can be driven (and CI-soaked)
+    # without an external load generator.  Storm futures retrieve their
+    # own outcomes: sheds and rejections are the point, not log spam.
+    from tpuic.runtime import faults as _faults
+    import threading as _threading
+    flood_stop = _threading.Event()
+    if _faults.fire("flood"):
+        flood_rate = _faults.param("flood")
+        flood_rate = 50.0 if flood_rate is None else float(flood_rate)
+        flood_img = np.zeros((1, size, size, 3), engine.input_dtype)
+
+        def _flood() -> None:
+            period = 1.0 / max(flood_rate, 1e-3)
+            while not flood_stop.is_set() and not guard.triggered:
+                try:
+                    fut = engine.submit(flood_img, timeout=0,
+                                        priority="low", tenant="_flood")
+                    fut.add_done_callback(
+                        lambda f: f.cancelled() or f.exception())
+                except Exception:  # noqa: BLE001 — rejects ARE the test
+                    pass
+                flood_stop.wait(period)
+
+        _threading.Thread(target=_flood, daemon=True,
+                          name="tpuic-flood").start()
+        print(f"[serve] fault 'flood' armed: synthetic low-priority "
+              f"storm at {flood_rate:g} req/s", file=sys.stderr)
+
     k = max(1, min(args.top_k, num_classes))
     out = open(args.out, "w") if args.out else sys.stdout
     pending = deque()  # (id, Future) in submission order
@@ -339,7 +445,15 @@ def main(argv=None) -> int:
                 out.flush()
                 return
             except Exception as e:  # noqa: BLE001 — per-request error line
-                out.write(json.dumps({"id": rid, "error": str(e)}) + "\n")
+                rec = {"id": rid, "error": str(e)}
+                if isinstance(e, AdmissionError):
+                    # Typed verdict (a pop-time DeadlineExceeded shed,
+                    # or an eviction): name the cause + class so the
+                    # response stream carries the same labels the
+                    # rejected_total counter does.
+                    rec["cause"] = e.cause
+                    rec["priority"] = e.priority
+                out.write(json.dumps(rec) + "\n")
                 out.flush()
                 continue
             except BaseException:
@@ -350,15 +464,36 @@ def main(argv=None) -> int:
                 raise
             emit(rid, probs, order)
 
-    def submit(rid: str, path: str) -> bool:
-        """Decode + enqueue; False = decode failed (error line emitted)."""
+    def submit(rid: str, path: str, **sla) -> bool:
+        """Decode + enqueue; False = decode failed (error line emitted).
+
+        ``sla``: per-request ``priority``/``deadline_ms``/``tenant``
+        from the request line.  With --admission the enqueue is
+        non-blocking: a typed rejection (queue full / quota / brownout)
+        becomes an immediate error line naming its cause instead of the
+        accept loop stalling behind a flood."""
         try:
             img = _load_image(path, size)
         except Exception as e:  # noqa: BLE001
             out.write(json.dumps({"id": rid, "error": f"decode: {e}"}) + "\n")
             out.flush()
             return False
-        pending.append((rid, engine.submit(img)))
+        try:
+            if engine.admission is not None:
+                sla.setdefault("timeout", 0)
+            pending.append((rid, engine.submit(img, **sla)))
+        except AdmissionError as e:
+            out.write(json.dumps({"id": rid, "error": str(e),
+                                  "cause": e.cause,
+                                  "priority": e.priority}) + "\n")
+            out.flush()
+            return True  # the request was handled: verdict delivered
+        except (ValueError, TypeError) as e:
+            # Bad SLA fields (unknown priority, non-numeric deadline)
+            # are the request's problem, not the server's.
+            out.write(json.dumps({"id": rid, "error": str(e)}) + "\n")
+            out.flush()
+            return True
         drain(block=False)  # opportunistic: decode overlaps device work
         return True
 
@@ -416,7 +551,17 @@ def main(argv=None) -> int:
                         {"error": f"bad request line: {line[:80]}"}) + "\n")
                     out.flush()
                     return
-                submit(str(req.get("id", path)), path)
+                # Optional SLA fields per request line — honored only
+                # under --admission (docs/serving.md): without the
+                # operator opt-in, a client self-assigning "high" could
+                # evict other clients' queued requests on a server
+                # whose policy is plain FIFO.
+                sla = {}
+                if engine.admission is not None:
+                    sla = {k: req[k] for k in ("priority", "deadline_ms",
+                                               "tenant") if req.get(k)
+                           is not None}
+                submit(str(req.get("id", path)), path, **sla)
 
             # select()-gated RAW reads, not ``for line in sys.stdin``: a
             # signal handler only sets the latch and PEP 475 would resume
@@ -474,6 +619,7 @@ def main(argv=None) -> int:
               deadline=time.monotonic() + max(0.0, args.drain_timeout))
     finally:
         guard.uninstall()
+        flood_stop.set()
         engine.close(timeout=max(5.0, args.drain_timeout))
         if prom_server is not None:
             prom_server.close()
@@ -486,6 +632,15 @@ def main(argv=None) -> int:
                 print(f"[serve] prom dump failed: {e}", file=sys.stderr)
         if slo_tracker is not None:
             print(f"[serve] slo: {slo_tracker.summary_line()}",
+                  file=sys.stderr)
+        if admission_ctl is not None:
+            # Attribution companion to the [slo] line: the rejected_by
+            # split says whether budget burn came from sheds (deadline /
+            # brownout causes) or from slow service (no sheds, blown
+            # attainment).
+            snap = engine.stats.snapshot()
+            print(f"[admission] state={json.dumps(admission_ctl.state())} "
+                  f"rejected_by={json.dumps(snap['rejected_by'])}",
                   file=sys.stderr)
         print(f"[serve] served {served} requests; stats: "
               f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)

@@ -46,12 +46,16 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
 import numpy as np
 
 from tpuic.runtime import faults as _faults
+from tpuic.serve.admission import (DEFAULT_PRIORITY, PRIORITIES,
+                                   AdmissionRejected, DeadlineExceeded,
+                                   priority_index)
 from tpuic.serve.metrics import SPAN_PHASES, ServeStats
 from tpuic.telemetry.events import bus as _tm_bus
 from tpuic.telemetry.events import publish as _tm_publish
@@ -102,16 +106,112 @@ class _Request:
     computed from (docs/observability.md, "Request tracing").  Stamps are
     ``time.monotonic()`` reads — no device interaction, ever."""
 
-    __slots__ = ("images", "n", "future", "trace", "t_enqueue", "t_gather")
+    __slots__ = ("images", "n", "future", "trace", "priority", "pidx",
+                 "tenant", "deadline", "t_enqueue", "t_gather")
 
     def __init__(self, images: np.ndarray, future: Future,
-                 trace: int = 0) -> None:
+                 trace: int = 0, priority: str = DEFAULT_PRIORITY,
+                 tenant: Optional[str] = None,
+                 deadline_ms: Optional[float] = None) -> None:
         self.images = images
         self.n = images.shape[0]
         self.future = future
         self.trace = trace
+        self.priority = priority
+        self.pidx = priority_index(priority)
+        self.tenant = tenant
         self.t_enqueue = time.monotonic()
         self.t_gather = self.t_enqueue  # stamped when the batcher pops it
+        # Absolute monotonic deadline; None = the caller waits forever.
+        self.deadline = (None if deadline_ms is None
+                         else self.t_enqueue + float(deadline_ms) / 1000.0)
+
+
+class _PriorityQueue:
+    """Bounded multi-class FIFO (docs/serving.md, "Admission control and
+    overload"): one lane per priority class, ``get`` pops the highest
+    populated class first and FIFO within it, so under contention
+    high-priority requests are batched first.  ``put`` on a full queue
+    may **evict** the youngest request of the lowest populated class
+    that is strictly below the arrival's — under overload the flood
+    waits (or sheds), never the traffic with an SLO.  All-one-class
+    traffic degrades to exactly the old bounded FIFO: nothing is ever
+    evicted by its own class, and ``queue.Full``/``queue.Empty`` keep
+    the stdlib semantics callers already handle."""
+
+    def __init__(self, maxsize: int) -> None:
+        self._maxsize = max(1, int(maxsize))
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._lanes = tuple(deque() for _ in PRIORITIES)
+        self._size = 0
+
+    def qsize(self) -> int:
+        with self._lock:
+            return self._size
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def _evict_locked(self, pidx: int) -> Optional[_Request]:
+        """Youngest request of the lowest class strictly below ``pidx``
+        (None when every queued request is >= the arrival's class)."""
+        for lane in reversed(self._lanes[pidx + 1:]):
+            if lane:
+                self._size -= 1
+                return lane.pop()
+        return None
+
+    def put(self, req: _Request,
+            timeout: Optional[float] = None) -> Optional[_Request]:
+        """Enqueue ``req``; returns the evicted lower-priority request
+        when admission came at someone else's expense (the caller owns
+        failing its future — this class never touches futures).
+        ``timeout=None`` blocks, ``0`` raises ``queue.Full`` at once,
+        else waits that long — only when no eviction candidate exists."""
+        with self._not_full:
+            deadline = (None if timeout is None
+                        else time.monotonic() + max(0.0, timeout))
+            while self._size >= self._maxsize:
+                victim = self._evict_locked(req.pidx)
+                if victim is not None:
+                    self._lanes[req.pidx].append(req)
+                    self._size += 1
+                    self._not_empty.notify()
+                    return victim
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise queue.Full
+                self._not_full.wait(remaining)
+            self._lanes[req.pidx].append(req)
+            self._size += 1
+            self._not_empty.notify()
+            return None
+
+    def put_nowait(self, req: _Request) -> Optional[_Request]:
+        return self.put(req, timeout=0)
+
+    def get(self, timeout: Optional[float] = None) -> _Request:
+        with self._not_empty:
+            deadline = (None if timeout is None
+                        else time.monotonic() + max(0.0, timeout))
+            while self._size == 0:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise queue.Empty
+                self._not_empty.wait(remaining)
+            for lane in self._lanes:
+                if lane:
+                    self._size -= 1
+                    self._not_full.notify()
+                    return lane.popleft()
+            raise queue.Empty  # unreachable: _size > 0 implies a lane
+
+    def get_nowait(self) -> _Request:
+        return self.get(timeout=0)
 
 
 class InferenceEngine:
@@ -141,7 +241,7 @@ class InferenceEngine:
                  max_wait_ms: float = 5.0, queue_size: int = 256,
                  normalize: bool = False, mean=None, std=None,
                  forward_fn=None, stats: Optional[ServeStats] = None,
-                 autostart: bool = True) -> None:
+                 admission=None, autostart: bool = True) -> None:
         import jax
 
         if not buckets:
@@ -167,8 +267,13 @@ class InferenceEngine:
         # Request-scoped tracing: every submit gets the next trace id
         # (itertools.count is safe under the GIL for concurrent callers).
         self._traces = itertools.count(1)
-        self._queue: "queue.Queue[_Request]" = queue.Queue(
-            maxsize=max(1, int(queue_size)))
+        # Submit-time admission (tpuic/serve/admission.py): brownout
+        # class shedding + per-tenant quotas.  None = admit everything
+        # the bounded queue takes (the pre-admission behavior).  Public
+        # and settable post-construction: the CLI driver attaches it
+        # after build_engine.
+        self.admission = admission
+        self._queue = _PriorityQueue(max(1, int(queue_size)))
         self._held: Optional[_Request] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -272,13 +377,30 @@ class InferenceEngine:
         return exe
 
     # -- request side --------------------------------------------------
-    def submit(self, images, *, timeout: Optional[float] = None) -> Future:
+    def submit(self, images, *, timeout: Optional[float] = None,
+               priority: str = DEFAULT_PRIORITY,
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue [n,S,S,C] (or one [S,S,C] row) for inference.
 
         Returns a Future resolving to the forward's pytree sliced to this
         request's n rows.  When the queue is full: ``timeout=None``
         blocks (backpressure), ``timeout=0`` raises ``queue.Full``
-        immediately, other values wait that long first.
+        immediately, other values wait that long first — unless a
+        strictly lower-priority request is queued, in which case IT is
+        evicted (its future gets a typed ``AdmissionRejected``) and this
+        one is admitted.
+
+        SLA fields (docs/serving.md, "Admission control and overload"):
+        ``priority`` is one of :data:`tpuic.serve.admission.PRIORITIES`
+        (higher classes are batched first under contention);
+        ``deadline_ms`` is this request's latency budget — once it
+        cannot be met the batcher sheds the request at pop time and the
+        future raises :class:`DeadlineExceeded` instead of burning a
+        batch slot; ``tenant`` names the quota bucket when an
+        :class:`AdmissionController` is attached, which may reject
+        up front with a typed, cause-labeled ``AdmissionRejected``
+        (also a ``queue.Full``, so old backpressure handlers work).
 
         The engine BORROWS the array until the future resolves (no
         defensive copy — the exact-bucket-fit path ships it to the
@@ -304,19 +426,49 @@ class InferenceEngine:
                              f"bucket {self.max_batch}; chunk it caller-side")
         if self._stop.is_set():
             raise RuntimeError("engine is closed")
+        # Validate the SLA fields BEFORE consulting admission: a
+        # malformed deadline failing after admit() would have consumed a
+        # quota token for a request that never enters the ledger.
+        priority_index(priority)
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)  # tpuic-ok: TPU101 SLA fields are host scalars by contract
+        if self.admission is not None:
+            verdict = self.admission.admit(priority=priority,
+                                           tenant=tenant)
+            if not verdict:
+                self.stats.record_reject(verdict.cause, priority)
+                raise AdmissionRejected(
+                    f"admission rejected ({verdict.cause}, "
+                    f"priority={priority}, tenant={tenant})",
+                    cause=verdict.cause, priority=priority, tenant=tenant)
         fut: Future = Future()
-        req = _Request(arr, fut, trace=next(self._traces))
+        req = _Request(arr, fut, trace=next(self._traces),
+                       priority=priority, tenant=tenant,
+                       deadline_ms=deadline_ms)
         # Caller-side correlation handle: a driver logging an error line
         # can name the same trace id the span ledger carries.
         fut.tpuic_trace = req.trace
         try:
-            if timeout == 0:
-                self._queue.put_nowait(req)
-            else:
-                self._queue.put(req, timeout=timeout)
+            evicted = self._queue.put(req, timeout=timeout)
         except queue.Full:
-            self.stats.record_reject()
+            self.stats.record_reject("queue_full", priority)
+            if self.admission is not None:
+                raise AdmissionRejected(
+                    f"queue full (priority={priority})",
+                    cause="queue_full", priority=priority,
+                    tenant=tenant) from None
             raise
+        if evicted is not None:
+            # Priority eviction: the displaced request gets the same
+            # typed queue_full verdict a rejected submit would — from
+            # ITS labels' point of view the queue was full of more
+            # important work.
+            self.stats.record_reject("queue_full", evicted.priority)
+            if not evicted.future.cancelled():
+                evicted.future.set_exception(AdmissionRejected(
+                    f"evicted by a higher-priority arrival "
+                    f"(priority={evicted.priority})", cause="queue_full",
+                    priority=evicted.priority, tenant=evicted.tenant))
         # Re-check after the put: a close() that ran inside the window
         # between the _stop check above and the put has already drained
         # the queue, and nothing will ever read this request — fail it
@@ -331,13 +483,40 @@ class InferenceEngine:
         return self.submit(images).result(timeout)
 
     # -- batcher thread ------------------------------------------------
+    def _maybe_shed(self, req: _Request) -> bool:
+        """Pop-time deadline shed (docs/serving.md): True when ``req``'s
+        deadline has already expired — or will, within the span ledger's
+        rolling estimate of the service time still ahead of it
+        (ServeStats.estimated_service_s) — in which case its future gets
+        a typed :class:`DeadlineExceeded` and the batch slot goes to a
+        request someone is still waiting for.  Batchmates are untouched:
+        shedding happens strictly before batch membership (the PR-2
+        isolation discipline).  Host-clock arithmetic only."""
+        if req.deadline is None:
+            return False
+        if time.monotonic() + self.stats.estimated_service_s() \
+                <= req.deadline:
+            return False
+        self.stats.record_reject("deadline", req.priority)
+        if not req.future.cancelled():
+            req.future.set_exception(DeadlineExceeded(
+                f"deadline expired before service (trace {req.trace}, "
+                f"priority={req.priority})", priority=req.priority,
+                tenant=req.tenant))
+        return True
+
     def _gather(self, idle_timeout: float):
-        """One coalescing decision: FIFO requests until max_batch rows or
-        max_wait_ms after the batch opened.  A request that would
-        overflow max_batch is held for the next batch (requests are
-        never split, so per-request results stay contiguous)."""
+        """One coalescing decision: requests (highest priority class
+        first, FIFO within a class) until max_batch rows or max_wait_ms
+        after the batch opened.  A request that would overflow max_batch
+        is held for the next batch (requests are never split, so
+        per-request results stay contiguous; the held request leads the
+        next batch regardless of class — held work is never starved).
+        Expired-deadline requests are shed here, at pop time."""
         first, self._held = self._held, None
-        if first is None:
+        if first is not None and self._maybe_shed(first):
+            first = None
+        while first is None:
             try:
                 first = self._queue.get(timeout=idle_timeout)
             except queue.Empty:
@@ -346,6 +525,8 @@ class InferenceEngine:
             # request keeps its ORIGINAL pop time — the wait while held
             # belongs to batch formation, not the queue.
             first.t_gather = time.monotonic()
+            if self._maybe_shed(first):
+                first = None
         reqs, rows = [first], first.n
         deadline = time.monotonic() + self.max_wait
         while rows < self.max_batch:
@@ -357,6 +538,8 @@ class InferenceEngine:
             except queue.Empty:
                 break
             nxt.t_gather = time.monotonic()
+            if self._maybe_shed(nxt):
+                continue
             if rows + nxt.n > self.max_batch:
                 self._held = nxt
                 break

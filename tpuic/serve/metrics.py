@@ -71,6 +71,15 @@ class ServeStats:
             self.compile_s = 0.0
             self.cache_hits = 0
             self.rejected = 0
+            # cause -> priority -> count (docs/serving.md, "Admission
+            # control and overload"): queue_full (backpressure or a
+            # priority eviction), deadline (pop-time shed), quota
+            # (token bucket dry), brownout (SLO-coupled class shed).
+            # ``rejected`` stays the total so pre-admission readers of
+            # the snapshot / the bare attribute keep working.
+            self.rejected_by: Dict[str, Dict[str, int]] = {}
+            self._est = 0.0            # cached estimated_service_s
+            self._est_t = float("-inf")
             self._t0 = time.monotonic()
 
     # -- engine-side updates -------------------------------------------
@@ -85,9 +94,16 @@ class ServeStats:
         with self._lock:
             self.cache_hits += 1
 
-    def record_reject(self) -> None:
+    def record_reject(self, cause: str = "queue_full",
+                      priority: str = "normal") -> None:
+        """One rejected/shed request, labeled by cause and priority
+        class.  ``accepted + rejected == offered`` is the ledger the
+        overload soak asserts exactly: every submit either resolves
+        (``requests``) or lands here under exactly one cause."""
         with self._lock:
             self.rejected += 1
+            by_prio = self.rejected_by.setdefault(cause, {})
+            by_prio[priority] = by_prio.get(priority, 0) + 1
 
     def record_dispatch(self, bucket: int, valid: int,
                         queue_waits) -> None:
@@ -114,6 +130,35 @@ class ServeStats:
                 self.spans[phase].update(s)
 
     # -- reads ---------------------------------------------------------
+    def estimated_service_s(self) -> float:
+        """Rolling estimate of the service time a *popped* request still
+        has ahead of it — the span ledger's p50s for every post-queue
+        phase (batch formation, staging, dispatch, device, scatter).
+        The deadline shedder uses it at pop time: a request whose
+        deadline will expire inside this estimate cannot make it, so
+        dispatching it would waste a batch slot on a dead answer.
+        0.0 until the ledger has samples (a cold engine sheds only
+        already-expired deadlines — it has no evidence to predict with).
+
+        Cached for ``max_age_s`` (the nearest-rank quantile sorts its
+        window): the batcher calls this once per pop, and a 50 ms-stale
+        estimate is far inside the noise of the thing it estimates.
+        """
+        max_age_s = 0.05
+        with self._lock:
+            now = time.monotonic()
+            if now - self._est_t < max_age_s:
+                return self._est
+            est = 0.0
+            for phase in SPAN_PHASES:
+                if phase == "queue":
+                    continue  # already behind a popped request
+                p50 = self.spans[phase].quantile_s(50)
+                if p50 is not None:
+                    est += p50
+            self._est, self._est_t = est, now
+            return est
+
     def pad_efficiency_rows(self) -> tuple:
         """(valid_rows, padded_rows) so far."""
         with self._lock:
@@ -144,5 +189,7 @@ class ServeStats:
                 "compile_s": round(self.compile_s, 3),
                 "executable_cache_hits": self.cache_hits,
                 "rejected": self.rejected,
+                "rejected_by": {c: dict(sorted(p.items())) for c, p in
+                                sorted(self.rejected_by.items())},
                 "elapsed_s": round(elapsed, 3),
             }

@@ -42,8 +42,8 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 # stick to these; docs/observability.md is the schema reference.
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
-               "serve_span", "slo", "trace", "goodput", "restart",
-               "heartbeat")
+               "serve_span", "slo", "admission", "trace", "goodput",
+               "restart", "heartbeat")
 
 
 @dataclasses.dataclass(frozen=True)

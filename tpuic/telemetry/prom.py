@@ -82,16 +82,57 @@ def slo_rows(slo_report: Optional[dict]) -> List[Tuple]:
     return rows
 
 
+def admission_rows(snapshot: dict,
+                   admission: Optional[dict] = None) -> List[Tuple]:
+    """The admission-control exposition (docs/serving.md, "Admission
+    control and overload"): the ``rejected_total`` counter split by
+    cause (``queue_full|deadline|quota|brownout``) and priority class —
+    the labels every typed :class:`tpuic.serve.admission.AdmissionError`
+    carries — plus, when an ``AdmissionController.state()`` dict is
+    handed in, the brownout level and remaining quota tokens.  A cause
+    that never fired renders no series (Prometheus treats an absent
+    counter as 0); the unlabeled total lives on in
+    ``snapshot()['rejected']`` for humans."""
+    rows: List[Tuple] = []
+    for cause, by_prio in (snapshot.get("rejected_by") or {}).items():
+        for prio, n in (by_prio or {}).items():
+            rows.append(("rejected_total", n, "counter",
+                         "requests rejected or shed, by cause "
+                         "(queue_full|deadline|quota|brownout) and "
+                         "priority class",
+                         {"cause": cause, "priority": prio}))
+    brownout = (admission or {}).get("brownout") or {}
+    if brownout.get("level") is not None:
+        rows.append(("brownout_level", brownout["level"], "gauge",
+                     "SLO-coupled brownout level (0 = admitting every "
+                     "class; level L sheds the L lowest classes)",
+                     {"slo": brownout.get("slo", "")}))
+    for tenant, tokens in ((admission or {}).get("tenant_tokens")
+                           or {}).items():
+        rows.append(("quota_tokens", tokens, "gauge",
+                     "remaining token-bucket quota per tenant",
+                     {"tenant": tenant}))
+    if (admission or {}).get("free_pool_tokens") is not None:
+        rows.append(("quota_tokens", admission["free_pool_tokens"],
+                     "gauge", "remaining token-bucket quota per tenant",
+                     {"tenant": "*"}))
+    return rows
+
+
 def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
                      heartbeat_age_s: Optional[float] = None,
-                     slo: Optional[dict] = None) -> str:
+                     slo: Optional[dict] = None,
+                     admission: Optional[dict] = None) -> str:
     """ServeStats.snapshot() -> Prometheus text.
 
     ``heartbeat_age_s``: seconds since the supervised-liveness heartbeat
     file was last written (runtime/supervisor.py), when the server runs
     under ``python -m tpuic.supervise``; omitted (None) unsupervised —
     a scraper alerting on staleness must not see a bogus 0.
-    ``slo``: an SLOTracker.report() to append (telemetry/slo.py)."""
+    ``slo``: an SLOTracker.report() to append (telemetry/slo.py).
+    ``admission``: an AdmissionController.state() for brownout/quota
+    gauges; the rejected_total{cause,priority} split renders from the
+    snapshot itself."""
     rows: List[Tuple] = [
         ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
          "seconds since the liveness heartbeat file was last written "
@@ -102,8 +143,6 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
          "images scored", None),
         ("device_calls_total", snapshot.get("device_calls"), "counter",
          "bucketed device dispatches", None),
-        ("rejected_total", snapshot.get("rejected"), "counter",
-         "requests rejected by queue backpressure", None),
         ("compiles_total", snapshot.get("compiles"), "counter",
          "bucket executable compiles (0 after warmup = the AOT contract)",
          None),
@@ -137,6 +176,7 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     for bucket, n in (snapshot.get("batch_hist") or {}).items():
         rows.append(("batches_total", n, "counter",
                      "device calls per padding bucket", {"bucket": bucket}))
+    rows.extend(admission_rows(snapshot, admission))
     rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)
 

@@ -226,6 +226,21 @@ class SLOTracker:
                                  else round(1.0 - burn, 4)),
         }
 
+    def burn_rate(self, name: str) -> Optional[float]:
+        """Current rolling burn rate of the named objective (None until
+        it has samples) — the poll-side twin of the ``slo`` events the
+        brownout controller (tpuic/serve/admission.py) consumes.  Raises
+        KeyError for an unknown name: a brownout coupled to an objective
+        this tracker doesn't carry would silently never tighten."""
+        for obj in self.objectives:
+            if obj.name == name:
+                with self._lock:
+                    return self._obj_report(
+                        obj, self._state[obj.name])["burn_rate"]
+        raise KeyError(
+            f"no SLO objective named {name!r} "
+            f"(configured: {', '.join(o.name for o in self.objectives)})")
+
     def report(self) -> dict:
         """{"objectives": [per-objective dicts]} — feed prom.slo_rows."""
         with self._lock:
