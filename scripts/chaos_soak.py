@@ -83,20 +83,14 @@ def _train_cmd(data: str, ckpt: str, cache: str, jsonl: str) -> list:
 
 
 def _events(path: str) -> list:
-    if not os.path.exists(path):
-        return []
     # A SIGKILL fault can tear a JSONL line mid-write, and the next
-    # attempt appends its first event onto the fragment; skip lines
-    # that don't parse rather than crashing the verdict path.
-    out = []
-    for ln in open(path):
-        if not ln.strip():
-            continue
-        try:
-            out.append(json.loads(ln))
-        except json.JSONDecodeError:
-            print(f"  [soak] skipping torn jsonl line in {path}: {ln[:80]!r}")
-    return out
+    # attempt appends its first event onto the fragment; the SHARED
+    # tolerant reader (telemetry/events.read_jsonl — also behind the
+    # regress gate and the fleet aggregator) skips lines that don't
+    # parse rather than crashing the verdict path.
+    from tpuic.telemetry.events import read_jsonl
+    return read_jsonl(path, on_torn=lambda ln: print(
+        f"  [soak] skipping torn jsonl line in {path}: {ln[:80]!r}"))
 
 
 def _evals(recs: list) -> dict:
@@ -228,6 +222,29 @@ def main() -> int:
             check("File" in body and len(body) > 50,
                   f"hang produced a faulthandler stack dump ({dump}, "
                   f"{len(body)} bytes)")
+            # Flight recorder (telemetry/flight.py): the same SIGQUIT
+            # must also have dumped the event timeline leading into the
+            # wedge — non-empty, parseable, and every recorded event
+            # stamped BEFORE the dump trailer (i.e. before the SIGQUIT
+            # was handled): stacks say where, the flight dump says what
+            # happened on the way in.
+            fdump = os.path.join(state_dir,
+                                 f"flightdump-{hung[0].attempt}.jsonl")
+            frecs = _events(fdump)
+            trailer = frecs[-1] if frecs else {}
+            body_evs = [r for r in frecs if r.get("event") != "flight_dump"]
+            check(trailer.get("event") == "flight_dump"
+                  and trailer.get("reason") == "sigquit",
+                  f"flight dump ends with a sigquit trailer ({fdump}, "
+                  f"{len(frecs)} records)")
+            check(len(body_evs) > 0 and any(
+                      r.get("event") == "step" for r in body_evs),
+                  f"flight dump carries the event timeline "
+                  f"({len(body_evs)} events incl. steps)")
+            check(bool(body_evs) and bool(trailer) and all(
+                      r.get("t", 1e18) <= trailer.get("t", 0)
+                      for r in body_evs),
+                  "every flight-dump event precedes the SIGQUIT trailer")
         codes = [a.returncode for a in sup.attempts]
         check(EXIT_PREEMPTED in codes,
               f"sigterm attempt exited {EXIT_PREEMPTED} per the contract "

@@ -56,11 +56,12 @@ def _force_cpu() -> None:
 
 
 def _rss_mb() -> float:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS:"):
-                return float(line.split()[1]) / 1024.0
-    return 0.0
+    """Current RSS in MB via the SHARED helper (tpuic.metrics.meters.
+    process_rss_bytes — the same read behind the prom gauge and the
+    memory sampler; this script used to carry its own /proc parser)."""
+    from tpuic.metrics.meters import process_rss_bytes
+    rss = process_rss_bytes()
+    return (rss or 0.0) / (1 << 20)
 
 
 def _committed_knee() -> float:

@@ -1,0 +1,548 @@
+"""Fleet observability (ISSUE 9 acceptance): device-memory sampling with
+the zero-sync/zero-compile contract, the crash flight recorder's ring
+bounds and dump-on-signal, rank tagging + per-rank streams, the fleet
+aggregator's skew math on synthetic rank streams, the shared tolerant
+JSONL reader's torn-tail policy, and the Prometheus memory/RSS rows."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpuic.telemetry import events as tme
+from tpuic.telemetry.events import (EVENT_KINDS, EventBus, JsonlSink,
+                                    MemorySink, read_jsonl)
+from tpuic.telemetry.flight import FlightRecorder
+from tpuic.telemetry.fleet import (aggregate, load_streams,
+                                   rank_stream_path, tag_bus_with_rank)
+from tpuic.telemetry.memory import MemorySampler
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- event-bus plumbing ------------------------------------------------------
+def test_new_event_kinds_registered():
+    assert "memory" in EVENT_KINDS
+    assert "flight_dump" in EVENT_KINDS
+
+
+def test_rank_tag_merged_into_every_event():
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    bus.publish("step", step=1, total_ms=5.0)
+    assert "rank" not in ms.events[-1].data  # untagged: schema unchanged
+    bus.rank_tag = {"rank": 3, "ranks": 8}
+    bus.publish("step", step=2, total_ms=5.0)
+    assert ms.events[-1].data["rank"] == 3
+    assert ms.events[-1].data["ranks"] == 8
+    # Emitter-provided keys win on collision (the tag is a default).
+    bus.publish("step", step=3, rank=7)
+    assert ms.events[-1].data["rank"] == 7
+    # reset() clears the tag (test isolation, like subscribers).
+    bus.reset()
+    assert bus.rank_tag is None
+
+
+def test_tag_bus_with_rank_sources(monkeypatch):
+    bus = EventBus()
+    # Single process (the live runtime here): no tag — the common path
+    # stays untouched.
+    assert tag_bus_with_rank(bus) == (0, 1)
+    assert bus.rank_tag is None
+    # Launcher env override (the CI fleet smoke's source).
+    monkeypatch.setenv("TPUIC_FLEET_RANK", "2")
+    monkeypatch.setenv("TPUIC_FLEET_RANKS", "4")
+    assert tag_bus_with_rank(bus) == (2, 4)
+    assert bus.rank_tag == {"rank": 2, "ranks": 4}
+    # Explicit arguments beat everything.
+    assert tag_bus_with_rank(bus, rank=1, ranks=3) == (1, 3)
+    assert bus.rank_tag == {"rank": 1, "ranks": 3}
+    # A half-set override fails loudly: silently collapsing every
+    # worker to rank 0/1 would interleave k processes into ONE stream.
+    monkeypatch.delenv("TPUIC_FLEET_RANKS")
+    with pytest.raises(ValueError, match="half-set"):
+        tag_bus_with_rank(bus)
+    # Same rule for half-set EXPLICIT arguments.
+    with pytest.raises(ValueError, match="both rank and ranks"):
+        tag_bus_with_rank(bus, rank=2)
+
+
+def test_rank_stream_path_convention():
+    assert rank_stream_path("a/events.jsonl", 0) == "a/events.jsonl"
+    assert rank_stream_path("a/events.jsonl", 3) == "a/events.rank3.jsonl"
+    assert rank_stream_path("noext", 2) == "noext.rank2.jsonl"
+
+
+def test_read_jsonl_tolerates_torn_lines(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "step", "step": 1}) + "\n")
+        f.write('{"event": "step", "st')          # torn mid-write
+        f.write(json.dumps({"event": "step", "step": 2}) + "\n")
+        f.write("\n")                              # blank line
+        f.write('{"event": "epoch", "epoch": 0}')  # unterminated tail: ok
+    torn = []
+    recs = read_jsonl(path, on_torn=torn.append)
+    # The torn fragment swallowed the following line (no newline between
+    # them) — exactly the chaos-soak failure mode; everything that
+    # parses survives, the fragment is reported, nothing raises.
+    assert [r["event"] for r in recs] == ["step", "epoch"]
+    assert len(torn) == 1 and torn[0].startswith('{"event": "step", "st')
+    assert read_jsonl(str(tmp_path / "missing.jsonl")) == []
+
+
+# -- flight recorder ---------------------------------------------------------
+def test_flight_recorder_ring_bound_and_trailer(tmp_path):
+    bus = EventBus()
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, capacity=8)
+    rec.subscribe(bus)
+    for i in range(50):
+        bus.publish("step", step=i, total_ms=1.0)
+        # Per-request firehose kinds are excluded at record time: a
+        # busy serve tier must not evict the coarse timeline the dump
+        # exists for (aggregate span stats live in the snapshot).
+        bus.publish("serve_span", trace=i, total_ms=2.0)
+    assert len(rec) == 8  # bounded: the ring keeps only the last N
+    t_before_dump = time.time()
+    assert rec.dump(reason="test") == path
+    recs = read_jsonl(path)
+    body, trailer = recs[:-1], recs[-1]
+    assert [r["step"] for r in body] == list(range(42, 50))
+    assert all(r["event"] == "step" for r in body)  # no spans recorded
+    assert trailer["event"] == "flight_dump"
+    assert trailer["reason"] == "test" and trailer["events"] == 8
+    # Every recorded event precedes the dump (the chaos-soak assertion).
+    assert all(r["t"] <= trailer["t"] for r in body)
+    assert trailer["t"] >= t_before_dump - 1.0
+    assert rec.dumps == 1
+
+
+def test_flight_recorder_dump_on_sigquit_in_process(tmp_path):
+    """The Python-level SIGQUIT handler dumps the ring and restores
+    cleanly; chaining to a previous Python handler is preserved."""
+    if not hasattr(signal, "SIGQUIT"):
+        pytest.skip("no SIGQUIT on this platform")
+    bus = EventBus()
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, capacity=16)
+    rec.subscribe(bus)
+    bus.publish("step", step=1, total_ms=2.0)
+    chained = []
+    prev_handler = signal.signal(signal.SIGQUIT,
+                                 lambda s, f: chained.append(s))
+    try:
+        assert rec.install_signal_handler()
+        os.kill(os.getpid(), signal.SIGQUIT)
+        time.sleep(0.05)  # handler runs at the next bytecode boundary
+        recs = read_jsonl(path)
+        assert recs and recs[-1]["reason"] == "sigquit"
+        assert recs[0]["event"] == "step"
+        assert chained == [signal.SIGQUIT]  # previous handler chained
+    finally:
+        signal.signal(signal.SIGQUIT, prev_handler)
+
+
+def test_flight_recorder_sigquit_chain_with_stack_dump(tmp_path):
+    """The full supervised protocol in a bare subprocess: the flight
+    recorder registers first, install_stack_dump_handler(chain=True)
+    rides the same SIGQUIT — one signal yields the faulthandler stack
+    dump AND the event-timeline dump (the train.py/serve wiring)."""
+    if not hasattr(signal, "SIGQUIT"):
+        pytest.skip("no SIGQUIT on this platform")
+    stack = str(tmp_path / "stack.txt")
+    flight = str(tmp_path / "flight.jsonl")
+    child = f"""
+import os, signal, sys, time
+sys.path.insert(0, {_REPO!r})
+from tpuic.telemetry.events import bus
+from tpuic.telemetry.flight import install_flight_recorder
+from tpuic.runtime.supervisor import install_stack_dump_handler
+rec = install_flight_recorder()
+assert rec is not None
+install_stack_dump_handler(chain=True)
+bus.publish("step", step=1, total_ms=3.0)
+bus.publish("quarantine", path="x.png", count=1)
+print("READY", flush=True)
+while True:
+    time.sleep(0.2)
+"""
+    env = dict(os.environ, TPUIC_STACK_DUMP=stack, TPUIC_FLIGHT_DUMP=flight)
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGQUIT)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not os.path.exists(flight):
+            time.sleep(0.05)
+    finally:
+        proc.kill()
+        proc.wait()
+    body = open(stack).read() if os.path.exists(stack) else ""
+    assert "File" in body  # faulthandler wrote real stacks
+    recs = read_jsonl(flight)
+    assert [r["event"] for r in recs] == ["step", "quarantine",
+                                          "flight_dump"]
+    assert recs[-1]["reason"] == "sigquit"
+    assert all(r["t"] <= recs[-1]["t"] for r in recs[:-1])
+
+
+def test_install_flight_recorder_noop_unsupervised(monkeypatch):
+    from tpuic.telemetry.flight import install_flight_recorder
+    monkeypatch.delenv("TPUIC_FLIGHT_DUMP", raising=False)
+    assert install_flight_recorder() is None
+
+
+# -- device-memory sampler ---------------------------------------------------
+def test_memory_sampler_cpu_fallback_fields():
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    keep = jnp.ones((256, 256), jnp.float32)  # something live to count
+    samp = MemorySampler(publish=bus.publish)
+    out = samp.sample(step=5)
+    assert out is not None and out["source"] == "live_arrays"
+    assert out["step"] == 5
+    assert out["bytes_in_use"] >= keep.nbytes
+    assert out["process_rss_bytes"] > 0
+    assert len(out["devices"]) == len(jax.local_devices())
+    for dev in out["devices"]:
+        assert {"device", "kind", "bytes_in_use"} <= set(dev)
+    # CPU knows no limit: no fabricated headroom.
+    assert "headroom_frac" not in out
+    ev = ms.of("memory")[-1]
+    assert ev.data["bytes_in_use"] == out["bytes_in_use"]
+    assert samp.snapshot() is out
+
+
+def test_memory_sampler_stats_headroom_and_oneshot_warning():
+    class FakeDev:
+        id = 0
+        device_kind = "TPU v5e"
+
+        def __init__(self):
+            self.in_use = 15 << 30
+
+        def memory_stats(self):
+            return {"bytes_in_use": self.in_use,
+                    "peak_bytes_in_use": self.in_use,
+                    "bytes_limit": 16 << 30}
+
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    logs = []
+    dev = FakeDev()
+    samp = MemorySampler(publish=bus.publish, devices=[dev],
+                         warn_headroom_frac=0.05, log=logs.append)
+    out = samp.sample(step=1)
+    assert out["source"] == "memory_stats"
+    assert out["bytes_limit"] == 16 << 30
+    assert out["headroom_frac"] == pytest.approx(1 / 16, abs=1e-3)
+    assert "warning" not in out and not logs  # 6% headroom: fine
+    dev.in_use = int(15.8 * 2**30)  # < 5% headroom now
+    out2 = samp.sample(step=2)
+    assert out2["warning"] == "low_headroom"
+    assert len(logs) == 1 and "LOW HEADROOM" in logs[0]
+    out3 = samp.sample(step=3)  # one-shot: still low, no re-warn
+    assert "warning" not in out3 and len(logs) == 1
+    kinds = [e.data.get("warning") for e in ms.of("memory")]
+    assert kinds == [None, "low_headroom", None]
+
+
+def test_memory_sampler_every_n_steps():
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    samp = MemorySampler(publish=bus.publish, every=3)
+    unsub = bus.subscribe(samp.on_event, kinds=("step",))
+    for i in range(7):
+        bus.publish("step", step=i + 1, total_ms=1.0)
+    unsub()
+    steps = [e.data.get("step") for e in ms.of("memory")]
+    assert steps == [1, 4, 7]
+
+
+def test_memory_sampler_fallback_auto_throttles():
+    """On the live_arrays fallback, a liveness registry past the
+    throttle threshold widens the step-boundary cadence (direct
+    sample() calls stay unthrottled)."""
+    keep = jnp.ones((8, 8))  # at least one live array
+    bus = EventBus()
+    ms = MemorySink()
+    bus.subscribe(ms)
+    samp = MemorySampler(publish=bus.publish,
+                         fallback_throttle_arrays=0, fallback_stride=4)
+    bus.subscribe(samp.on_event, kinds=("step",))
+    for i in range(9):
+        bus.publish("step", step=i + 1, total_ms=1.0)
+    # Step 1 sampled (walk sees > 0 arrays -> stride 4 engages), then
+    # only every 4th boundary.
+    assert [e.data.get("step") for e in ms.of("memory")] == [1, 5, 9]
+    assert samp.sample(step=100) is not None  # direct calls unthrottled
+    del keep
+
+
+def test_memory_sampler_and_rank_tag_zero_syncs_zero_compiles(tmp_path):
+    """The acceptance contract (same shape as the PR-3 StepTimer proof):
+    after warmup, the loop performs ZERO backend compiles and the
+    device_get count is IDENTICAL with memory sampling + rank tagging
+    on vs. off — both are host-side metadata/dict plumbing, nothing
+    else."""
+    from tpuic.analysis import runtime as contracts
+
+    @jax.jit
+    def step(s, x):
+        s = s + x.sum()
+        return s, {"loss": s}
+
+    # Warm every executable the loop touches (the jitted step AND the
+    # eager ones/zeros/mul helpers), so the measured loops run under the
+    # strict zero-compile contract.
+    state = jnp.zeros(())
+    state, m = step(state, jnp.ones((4,)) * 0)
+    jax.device_get({"loss": m["loss"]})
+
+    def loop(sampling: bool):
+        bus = EventBus()
+        sink = JsonlSink(str(tmp_path / f"ev_{sampling}.jsonl"))
+        bus.subscribe(sink)
+        samp = None
+        if sampling:
+            tag_bus_with_rank(bus, rank=1, ranks=4)
+            samp = MemorySampler(publish=bus.publish)
+            bus.subscribe(samp.on_event, kinds=("step",))
+        with contracts.assert_compiles_flat(
+                0, what=f"memory sampler loop (sampling={sampling})"):
+            with contracts.count_device_gets() as gets:
+                state = jnp.zeros(())
+                for i in range(6):
+                    state, m = step(state, jnp.ones((4,)) * i)
+                    jax.device_get({"loss": m["loss"]})  # the deferred drain
+                    bus.publish("step", step=i + 1, total_ms=1.0,
+                                data_ms=0.1, dispatch_ms=0.1)
+        sink.close()
+        if sampling:
+            assert samp.samples == 6  # it really ran, every step
+            recs = read_jsonl(str(tmp_path / "ev_True.jsonl"))
+            assert recs and all(r["rank"] == 1 for r in recs)  # tagged
+        return gets.count
+
+    gets_off = loop(False)
+    gets_on = loop(True)
+    assert gets_on == gets_off == 6
+    assert contracts.jit_cache_size(step) == 1
+
+
+def test_train_telemetry_wires_memory_and_rank(tmp_path, monkeypatch):
+    """TrainTelemetry samples memory at step boundaries and tags events
+    with the launcher-declared rank; the JSONL stream lands at the
+    per-rank derived path."""
+    import tpuic.telemetry as tm
+    monkeypatch.setenv("TPUIC_FLEET_RANK", "1")
+    monkeypatch.setenv("TPUIC_FLEET_RANKS", "2")
+    tme.bus.reset()
+    jsonl = str(tmp_path / "events.jsonl")
+    tt = tm.TrainTelemetry(SimpleNamespace(metrics_jsonl=jsonl),
+                           model_name="resnet18-cifar", image_size=32,
+                           global_batch=4)
+    keep = jnp.ones((64, 64), jnp.float32)  # live bytes for the sampler
+    try:
+        tme.bus.publish("step", step=1, total_ms=10.0, data_ms=1.0,
+                        dispatch_ms=0.5, device_ms=8.5)
+    finally:
+        tt.close()
+        tme.bus.reset()
+    derived = rank_stream_path(jsonl, 1)
+    assert not os.path.exists(jsonl)
+    recs = read_jsonl(derived)
+    kinds = [r["event"] for r in recs]
+    assert "step" in kinds and "memory" in kinds
+    for r in recs:
+        assert r["rank"] == 1 and r["ranks"] == 2
+    mem = next(r for r in recs if r["event"] == "memory")
+    assert mem["step"] == 1 and mem["bytes_in_use"] >= keep.nbytes
+
+
+# -- fleet aggregator --------------------------------------------------------
+def _stream(rank, totals, start_step=1):
+    return [{"event": "step", "step": start_step + i, "rank": rank,
+             "total_ms": t, "data_ms": 1.0, "dispatch_ms": 0.5,
+             "device_ms": t - 1.5}
+            for i, t in enumerate(totals)]
+
+
+def test_aggregate_skew_math_exact():
+    streams = {0: _stream(0, [100.0] * 10),
+               1: _stream(1, [150.0] * 10),
+               2: _stream(2, [110.0] * 10)}
+    rep = aggregate(streams)
+    assert rep["ranks"] == [0, 1, 2] and rep["steps_common"] == 10
+    # Per-step spread: max - min = 50 ms, every step.
+    assert rep["spread_ms"] == {"p50": 50.0, "p99": 50.0, "max": 50.0}
+    # Slowest-rank histogram: rank 1 wins every step.
+    assert rep["per_rank"]["1"]["slowest_steps"] == 10
+    assert rep["per_rank"]["0"]["slowest_steps"] == 0
+    # Estimated collective wait = rank total minus fleet min, summed.
+    assert rep["per_rank"]["0"]["est_collective_wait_ms"] == 0.0
+    assert rep["per_rank"]["1"]["est_collective_wait_ms"] == 500.0
+    assert rep["per_rank"]["2"]["est_collective_wait_ms"] == 100.0
+    s = rep["straggler"]
+    assert s["rank"] == 1 and s["slowest_step_frac"] == 1.0
+    assert s["excess_share"] == pytest.approx(500.0 / 600.0, abs=1e-4)
+    assert rep["per_rank"]["1"]["p50_ms"] == 150.0
+    assert rep["per_rank"]["1"]["mean_device_ms"] == pytest.approx(148.5)
+
+
+def test_aggregate_warmup_and_partial_steps():
+    # Rank 1 reported two extra steps no one else saw (died later /
+    # started earlier): only fleet-common steps enter the math.
+    streams = {0: _stream(0, [100.0] * 6),
+               1: _stream(1, [2000.0, 130.0, 130.0, 130.0, 130.0, 130.0]
+                          + [130.0, 130.0])}
+    rep = aggregate(streams, warmup=1)  # drop the compile-warmup step
+    assert rep["steps_common"] == 5
+    assert rep["per_rank"]["1"]["est_collective_wait_ms"] == \
+        pytest.approx(5 * 30.0)
+    assert rep["straggler"]["rank"] == 1
+    # Without warmup the 2000 ms compile step would dominate the ledger.
+    rep_all = aggregate(streams)
+    assert rep_all["steps_common"] == 6
+    assert rep_all["per_rank"]["1"]["est_collective_wait_ms"] == \
+        pytest.approx(1900.0 + 5 * 30.0)
+
+
+def test_aggregate_single_rank_has_no_straggler():
+    rep = aggregate({0: _stream(0, [100.0] * 4)})
+    assert rep["straggler"] is None
+    assert rep["steps_common"] == 4
+    assert "duplicate_steps" not in rep
+
+
+def test_aggregate_surfaces_restart_duplicates():
+    """A supervised restart replays step numbers into the appended
+    stream; the collapse is last-wins but COUNTED — mixed-attempt walls
+    must not pose as exact skew."""
+    from tpuic.telemetry.fleet import summary_lines
+    replayed = _stream(0, [100.0] * 6) + _stream(0, [90.0] * 3,
+                                                 start_step=4)
+    rep = aggregate({0: replayed, 1: _stream(1, [150.0] * 6)})
+    assert rep["duplicate_steps"] == {"0": 3}
+    # last occurrence won: steps 4-6 use the replayed 90 ms walls
+    assert rep["per_rank"]["0"]["p50_ms"] in (90.0, 100.0)
+    assert rep["per_rank"]["1"]["est_collective_wait_ms"] == \
+        pytest.approx(3 * 50.0 + 3 * 60.0)
+    assert any("duplicate step records" in ln for ln in summary_lines(rep))
+
+
+def test_load_streams_rank_sources_and_cli(tmp_path):
+    """Stream grouping: the record's own rank field wins, the filename
+    convention covers untagged streams; the CLI renders the verdict and
+    --expect-straggler gates on it."""
+    d = tmp_path / "fleet"
+    d.mkdir()
+    with open(d / "events.jsonl", "w") as f:      # tagged rank 0
+        for r in _stream(0, [100.0] * 6):
+            f.write(json.dumps(r) + "\n")
+    with open(d / "events.rank1.jsonl", "w") as f:  # untagged: filename
+        for r in _stream(1, [180.0] * 6):
+            r.pop("rank")
+            f.write(json.dumps(r) + "\n")
+        f.write('{"torn')  # tolerant reader on the aggregation path too
+    streams = load_streams([str(d)])
+    assert sorted(streams) == [0, 1]
+    assert all(r.get("rank", 1) == 1 for r in streams[1])
+
+    from tpuic.telemetry import fleet
+    out = str(tmp_path / "report.json")
+    rc = fleet.main([str(d), "--json", out, "--expect-straggler", "1"])
+    assert rc == 0
+    rep = json.load(open(out))
+    assert rep["straggler"]["rank"] == 1
+    assert rep["per_rank"]["1"]["est_collective_wait_ms"] == \
+        pytest.approx(6 * 80.0)
+    # The gate really gates: a wrong expectation fails.
+    assert fleet.main([str(d), "--expect-straggler", "0"]) == 1
+    # And an empty directory is a loud error, not a silent pass.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert fleet.main([str(empty)]) == 2
+
+
+# -- prometheus rows ---------------------------------------------------------
+def test_prom_memory_and_rss_rows():
+    from tpuic.telemetry.goodput import GoodputTracker
+    from tpuic.telemetry.prom import (memory_rows, serve_exposition,
+                                      train_exposition)
+    mem = {"source": "memory_stats",
+           "devices": [{"device": "0", "kind": "TPU v5e",
+                        "bytes_in_use": 100, "peak_bytes_in_use": 120,
+                        "bytes_limit": 200, "headroom_frac": 0.5},
+                       {"device": "1", "kind": "TPU v5e",
+                        "bytes_in_use": 90}]}
+    rows = memory_rows(mem)
+    assert rows[0][:3] == ("device_memory_bytes", 100, "gauge")
+    assert rows[0][4] == {"device": "0", "kind": "in_use"}
+    assert memory_rows(None) == []
+    gt = GoodputTracker(flops_per_step=1e9, peak_flops=1e12)
+    gt.start()
+    text = train_exposition(gt.report(), memory=mem)
+    assert 'tpuic_train_device_memory_bytes{device="0",kind="in_use"} 100' \
+        in text
+    assert 'tpuic_train_device_memory_bytes{device="0",kind="peak"} 120' \
+        in text
+    assert 'tpuic_train_device_memory_bytes{device="0",kind="limit"} 200' \
+        in text
+    assert 'tpuic_train_device_memory_headroom_frac{device="0"} 0.5' in text
+    assert 'tpuic_train_device_memory_bytes{device="1",kind="in_use"} 90' \
+        in text
+    # device 1 reported no limit: no fabricated headroom/limit rows
+    assert 'device="1",kind="limit"' not in text
+    assert "tpuic_train_process_rss_bytes " in text
+
+    from tpuic.serve.metrics import ServeStats
+    stext = serve_exposition(ServeStats().snapshot(), memory=mem)
+    assert 'tpuic_serve_device_memory_bytes{device="0",kind="in_use"}' \
+        in stext
+    assert "tpuic_serve_process_rss_bytes " in stext
+    # No snapshot: no memory series at all (absent, not 0).
+    assert "device_memory_bytes" not in serve_exposition(
+        ServeStats().snapshot())
+
+
+def test_process_rss_bytes_shared_helper():
+    from tpuic.metrics.meters import process_rss_bytes
+    rss = process_rss_bytes()
+    assert rss is not None and rss > 1 << 20  # a live interpreter > 1 MB
+
+
+# -- tensorboard sink --------------------------------------------------------
+def test_tensorboard_sink_memory_scalars():
+    from tpuic.telemetry.events import Event, TensorBoardSink
+
+    class StubTB:
+        def __init__(self):
+            self.calls = []
+
+        def scalars(self, step, **kw):
+            self.calls.append((step, kw))
+
+    tb = StubTB()
+    sink = TensorBoardSink(tb)
+    sink(Event("memory", time.time(),
+               {"step": 7, "bytes_in_use": 1000, "peak_bytes_in_use": 1200,
+                "process_rss_bytes": 5000, "headroom_frac": 0.25,
+                "devices": []}))
+    assert tb.calls == [(7, {"memory_bytes_in_use": 1000.0,
+                             "memory_peak_bytes_in_use": 1200.0,
+                             "memory_process_rss_bytes": 5000.0,
+                             "memory_headroom_frac": 0.25})]

@@ -64,6 +64,35 @@ def quantiles(samples: Sequence[float],
     return {quantile_label(q): s[_rank(len(s), q) - 1] for q in qs}
 
 
+def process_rss_bytes():
+    """Resident set size of THIS process, in bytes (None when unknowable).
+
+    THE shared host-memory read: the overload soak's leak bound, the
+    device-memory sampler's host companion row, and the
+    ``process_rss_bytes`` gauge on both Prometheus expositions all call
+    this one helper — there is deliberately no second ``/proc`` parser.
+    Primary source is ``/proc/self/status`` (current RSS); the fallback
+    is ``resource.getrusage`` whose ``ru_maxrss`` is the *peak* RSS
+    (documented platform semantics — still the right alarm signal when
+    ``/proc`` is unavailable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+        peak = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        # ru_maxrss units are platform-defined: macOS (the realistic
+        # no-/proc platform for this fallback) reports bytes, linux KiB.
+        return peak if sys.platform == "darwin" else peak * 1024.0
+    except Exception:
+        return None
+
+
 class AverageMeter:
     """Running average with the reference's update semantics (utils.py:16-20)."""
 

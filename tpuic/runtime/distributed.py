@@ -64,10 +64,22 @@ def initialize(coordinator_address: str | None = None,
     launcher's environment markers are present (multi-worker TPU pod /
     explicit coordinator address) — in case (c) with no arguments, letting
     JAX auto-discover the topology. Plain single-process runs skip it.
+
+    Launchers without a cluster runtime (CPU fleets, the CI fleet smoke
+    — scripts/fleet_smoke.py) pass the rendezvous through the
+    environment instead of code: TPUIC_COORDINATOR_ADDRESS +
+    TPUIC_NUM_PROCESSES + TPUIC_PROCESS_ID fill any argument the caller
+    left None, so ``python train.py`` joins a fleet without new flags.
     """
     global _initialized
+    if coordinator_address is None:
+        coordinator_address = (
+            os.environ.get("TPUIC_COORDINATOR_ADDRESS") or None)
+    if num_processes is None and os.environ.get("TPUIC_NUM_PROCESSES"):
+        num_processes = int(os.environ["TPUIC_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("TPUIC_PROCESS_ID"):
+        process_id = int(os.environ["TPUIC_PROCESS_ID"])
     multi = (coordinator_address is not None
-             or int(os.environ.get("TPUIC_NUM_PROCESSES", "1")) > 1
              or num_processes not in (None, 1)
              or _looks_multi_host())
     if multi and not _initialized:

@@ -88,6 +88,7 @@ EXIT_CRASH_LOOP = 45  # supervisor verdict: retries exhausted / no progress
 ENV_HEARTBEAT_FILE = "TPUIC_HEARTBEAT_FILE"
 ENV_HEARTBEAT_INTERVAL = "TPUIC_HEARTBEAT_INTERVAL_S"
 ENV_STACK_DUMP = "TPUIC_STACK_DUMP"
+ENV_FLIGHT_DUMP = "TPUIC_FLIGHT_DUMP"  # telemetry/flight.py reads it
 ENV_RESTART = "TPUIC_RESTART"
 ENV_DOWN_SINCE = "TPUIC_DOWN_SINCE"
 
@@ -255,7 +256,7 @@ def restart_info() -> Optional[Tuple[int, float]]:
 _DUMP_FILES: List = []  # keep registered faulthandler files alive
 
 
-def install_stack_dump_handler() -> Optional[str]:
+def install_stack_dump_handler(chain: bool = False) -> Optional[str]:
     """Register a ``faulthandler`` all-thread stack dump on SIGQUIT.
 
     The supervisor's hang escalation sends SIGQUIT first precisely so a
@@ -263,7 +264,15 @@ def install_stack_dump_handler() -> Optional[str]:
     Dumps go to ``$TPUIC_STACK_DUMP`` when the supervisor set it (the
     captured artifact the chaos soak asserts on), else stderr. Returns
     the destination, or None when registration is impossible (no
-    SIGQUIT on this platform, non-main thread)."""
+    SIGQUIT on this platform, non-main thread).
+
+    ``chain=True`` additionally invokes whatever Python-level SIGQUIT
+    handler was installed *before* this call, after the C-level stack
+    dump — how the flight recorder's event-timeline dump
+    (telemetry/flight.py) rides the same signal: register the Python
+    handler first, then call this with ``chain=True``, and a SIGQUIT
+    yields stacks (always, C-level) plus the event history (when the
+    main thread still executes bytecode)."""
     if not hasattr(signal, "SIGQUIT"):
         return None
     import faulthandler
@@ -278,7 +287,7 @@ def install_stack_dump_handler() -> Optional[str]:
             path, target = "", sys.stderr
     try:
         faulthandler.register(signal.SIGQUIT, file=target, all_threads=True,
-                              chain=False)
+                              chain=chain)
     except (ValueError, OSError, RuntimeError):
         return None
     if target is not sys.stderr:
@@ -360,6 +369,7 @@ class Supervisor:
                                               file=sys.stderr, flush=True))
         self._child: Optional[subprocess.Popen] = None
         self._shutdown = False
+        self._term_pid: Optional[int] = None  # child pid already SIGTERMed
         self.restarts = 0        # total (incl. clean preemption flushes)
         self.crash_restarts = 0  # retryable failures only — the budget
         self.attempts: List[AttemptResult] = []
@@ -382,9 +392,16 @@ class Supervisor:
     def _on_signal(self, signum, frame) -> None:
         self._shutdown = True
         child = self._child
-        if child is not None and child.poll() is None:
+        # One SIGTERM per child, here too: a repeated external SIGTERM
+        # (impatient orchestrator) must not deliver a second TERM that
+        # can land inside the child's flush sys.exit(43) after
+        # finalization restored the default handler (see the shutdown
+        # branch in _run_attempt).
+        if (child is not None and child.poll() is None
+                and self._term_pid != child.pid):
             try:
                 child.send_signal(signal.SIGTERM)  # the PR-2 flush path
+                self._term_pid = child.pid
             except OSError:
                 pass
 
@@ -404,6 +421,11 @@ class Supervisor:
         env[ENV_HEARTBEAT_INTERVAL] = repr(self.heartbeat_interval_s)
         env[ENV_STACK_DUMP] = os.path.join(self.state_dir,
                                            f"stackdump-{attempt}.txt")
+        # Flight recorder (telemetry/flight.py): the child dumps its
+        # last-N-events ring here on SIGQUIT — the hang escalation now
+        # yields stacks AND the event timeline leading into the wedge.
+        env[ENV_FLIGHT_DUMP] = os.path.join(self.state_dir,
+                                            f"flightdump-{attempt}.jsonl")
         env[ENV_RESTART] = str(attempt)
         env[ENV_DOWN_SINCE] = repr(down_since)
         if self.chaos:
@@ -453,9 +475,17 @@ class Supervisor:
                 # Usually the handler already forwarded SIGTERM — but a
                 # child spawned AFTER the flag was set (signal landed
                 # between attempts, when _child was None) never got it;
-                # send it here (idempotent), give the child the full
-                # grace window to flush, then make sure it dies.
-                self._signal(signal.SIGTERM)
+                # send it here, give the child the full grace window to
+                # flush, then make sure it dies. Only to a child that
+                # never got the forward: a SECOND SIGTERM is NOT
+                # harmless — it can land while the child is already
+                # inside its flush's sys.exit(43), where interpreter
+                # finalization has restored the default handler, and
+                # kill it -15 mid-exit (a ~1-in-12 flake in the shared-
+                # eviction test, caught live in PR 8).
+                if self._term_pid != self._child.pid:
+                    self._signal(signal.SIGTERM)
+                    self._term_pid = self._child.pid
                 try:
                     self._child.wait(timeout=self.grace_s)
                 except subprocess.TimeoutExpired:
@@ -475,7 +505,8 @@ class Supervisor:
                           f"SIGTERM, then SIGKILL")
                 self._ledger("hang", attempt=attempt, stale_s=round(stale, 1),
                              last_step=last_step,
-                             stack_dump=env[ENV_STACK_DUMP])
+                             stack_dump=env[ENV_STACK_DUMP],
+                             flight_dump=env[ENV_FLIGHT_DUMP])
                 if hasattr(signal, "SIGQUIT"):
                     self._signal(signal.SIGQUIT)
                     try:  # let faulthandler finish writing the dump
@@ -483,6 +514,11 @@ class Supervisor:
                     except subprocess.TimeoutExpired:
                         pass
                 self._signal(signal.SIGTERM)
+                # Record it (like every other TERM-send site): a
+                # concurrent external SIGTERM's handler must not
+                # deliver a SECOND TERM into the child's flush
+                # finalization window.
+                self._term_pid = self._child.pid
                 try:
                     self._child.wait(timeout=self.grace_s)
                 except subprocess.TimeoutExpired:

@@ -298,12 +298,21 @@ def main(argv=None) -> int:
     # under `python -m tpuic.supervise` the parent sets the heartbeat
     # env; mirror engine activity (serve_batch events) into the file AND
     # tick it from the accept loop — an idle server with no requests is
-    # alive, and the watchdog must see that, not a stale file.
+    # alive, and the watchdog must see that, not a stale file. The
+    # flight recorder (telemetry/flight.py) registers its SIGQUIT dump
+    # FIRST so the faulthandler stack dump chains into it: the
+    # supervisor's hang escalation then captures stacks + the event
+    # timeline (serve_batch/admission/slo — memory samples are
+    # scrape-side only here, see the sampler below) leading into the
+    # wedge.
     from tpuic.runtime.supervisor import (HeartbeatWriter,
                                           install_stack_dump_handler)
+    from tpuic.telemetry.flight import install_flight_recorder
+    flight = install_flight_recorder()
     heartbeat = HeartbeatWriter.from_env()
+    if heartbeat is not None or flight is not None:
+        install_stack_dump_handler(chain=flight is not None)
     if heartbeat is not None:
-        install_stack_dump_handler()
         from tpuic.telemetry.events import bus as _bus
         _bus.subscribe(heartbeat)
 
@@ -331,7 +340,19 @@ def main(argv=None) -> int:
         print(f"[serve] admission control on: "
               f"{json.dumps(admission_ctl.state())}", file=sys.stderr)
 
+    # Device-memory accounting (telemetry/memory.py): sampled at scrape
+    # time (each /metrics hit, each --prom-dump tick, and shutdown) —
+    # the serve tier has no step boundary, and a scrape-time metadata
+    # read is free of the request path entirely. Deliberately NOT
+    # published to the bus: scrapes run in the PromServer thread at the
+    # scraper's cadence, and the supervised-liveness heartbeat treats
+    # any bus activity as proof of life — an external scraper must not
+    # keep a wedged server looking alive to the watchdog.
+    from tpuic.telemetry.memory import MemorySampler
+    mem_sampler = MemorySampler(publish=lambda *a, **kw: None)
+
     def _prom_text() -> str:
+        mem_sampler.sample()
         return serve_exposition(
             engine.stats.snapshot(),
             heartbeat_age_s=(heartbeat.age_s() if heartbeat is not None
@@ -339,7 +360,8 @@ def main(argv=None) -> int:
             slo=(slo_tracker.report() if slo_tracker is not None
                  else None),
             admission=(admission_ctl.state() if admission_ctl is not None
-                       else None))
+                       else None),
+            memory=mem_sampler.snapshot())
 
     prom_server = None
     if args.prom_port:

@@ -26,6 +26,17 @@ before/after evidence (docs/observability.md):
   via ``TPUIC_TRACE=dir``), writing to a bounded trace dir.
 - ``prom``     — Prometheus-style text exposition of serve and train
   counters (``python -m tpuic.serve --prom-dump/--prom-port``).
+- ``memory``   — per-device **memory accounting** sampled at step
+  boundaries (allocator counters where the backend provides them,
+  live-array bytes + RSS on CPU): ``memory`` events, TensorBoard
+  scalars, ``device_memory_bytes{device,kind}`` prom rows, one-shot
+  low-headroom warning.
+- ``flight``   — **crash flight recorder**: a bounded ring of the last
+  N events, dumped as ``flightdump-<attempt>.jsonl`` on SIGQUIT /
+  fatal exit alongside the supervisor's stack dumps.
+- ``fleet``    — **per-rank fleet view**: rank-tagged events, per-rank
+  JSONL streams, and the offline straggler-attribution aggregator
+  (``python -m tpuic.telemetry.fleet <dir>``).
 
 Everything is host-side: no module here ever calls ``jax.device_get``
 or adds device work (test-asserted), so telemetry can stay on in
@@ -40,10 +51,13 @@ from typing import Optional
 from tpuic.telemetry.events import (Event, EventBus, JsonlSink,  # noqa: F401
                                     MemorySink, TensorBoardSink, bus,
                                     install_jax_compile_listener, publish,
-                                    subscribe)
+                                    read_jsonl, subscribe)
+from tpuic.telemetry.flight import (FlightRecorder,  # noqa: F401
+                                    install_flight_recorder)
 from tpuic.telemetry.goodput import (GoodputTracker,  # noqa: F401
                                      PEAK_FLOPS, analytic_flops_per_step,
                                      peak_flops)
+from tpuic.telemetry.memory import MemorySampler  # noqa: F401
 from tpuic.telemetry.slo import (Objective, SLOTracker,  # noqa: F401
                                  parse_objectives)
 from tpuic.telemetry.steptime import StepTimer  # noqa: F401
@@ -76,17 +90,24 @@ class TrainTelemetry:
         # Compile events (the jax.monitoring bridge) feed the goodput
         # compile bucket; idempotent, process-wide.
         install_jax_compile_listener()
+        # Fleet view (telemetry/fleet.py, docs/observability.md): on a
+        # multi-process run every event gains rank/ranks fields (one
+        # dict merge at publish; single-process runs keep the tag off
+        # and pay one attribute read).
+        from tpuic.telemetry.fleet import rank_stream_path, tag_bus_with_rank
+        self.rank, self.ranks = tag_bus_with_rank(bus)
         jsonl = getattr(run_cfg, "metrics_jsonl", "") or ""
         if jsonl:
-            # Host-0 only, the MetricLogger rule: on a multi-host pod
-            # every process runs the loop and would otherwise append its
-            # own events (and its own final goodput report) into the
-            # same file on the shared filesystem.
-            from tpuic.metrics.logging import is_host0
-            if is_host0():
-                sink = JsonlSink(jsonl)
-                self._sinks.append(sink)
-                self._unsubs.append(bus.subscribe(sink))
+            # Per-rank streams: rank 0 keeps the configured path (the
+            # single-process contract every consumer was built on);
+            # rank k writes '<stem>.rank<k>.jsonl' beside it — on a
+            # shared filesystem the fleet's whole history lands in one
+            # directory with no cross-process appends, and
+            # 'python -m tpuic.telemetry.fleet <dir>' merges it into
+            # straggler attribution offline.
+            sink = JsonlSink(rank_stream_path(jsonl, self.rank))
+            self._sinks.append(sink)
+            self._unsubs.append(bus.subscribe(sink))
         # Supervised-liveness heartbeat (runtime/supervisor.py,
         # docs/robustness.md): when a supervisor parent set
         # TPUIC_HEARTBEAT_FILE for this process, mirror bus activity into
@@ -100,6 +121,15 @@ class TrainTelemetry:
         if self.heartbeat is not None:
             self._unsubs.append(bus.subscribe(self.heartbeat))
         self.steptime = StepTimer(bus)
+        # Device-memory accounting (telemetry/memory.py): one host-side
+        # metadata sample per step boundary — allocator counters where
+        # the backend provides them, live-array bytes + RSS on CPU.
+        # Zero device syncs, zero compiles (checker-asserted in
+        # tests/test_fleet.py, the same discipline as the StepTimer).
+        from tpuic.metrics.logging import host0_print
+        self.memory = MemorySampler(publish=bus.publish, log=host0_print)
+        self._unsubs.append(bus.subscribe(self.memory.on_event,
+                                          kinds=("step",)))
         flops = analytic_flops_per_step(model_name, image_size, global_batch)
         peak = peak_flops(device) * max(1, int(n_devices))
         self.goodput = GoodputTracker(flops_per_step=flops, peak_flops=peak,
@@ -136,8 +166,8 @@ class TrainTelemetry:
             # serve latencies as scalars through the same sink.
             self._unsubs.append(bus.subscribe(
                 tbs, kinds=("step", "skip", "rollback", "quarantine",
-                            "goodput", "restart", "slo", "serve_batch",
-                            "serve_span")))
+                            "goodput", "restart", "slo", "memory",
+                            "serve_batch", "serve_span")))
 
     def flush(self) -> None:
         for s in self._sinks:

@@ -43,7 +43,7 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
                "serve_span", "slo", "admission", "trace", "goodput",
-               "restart", "heartbeat")
+               "restart", "heartbeat", "memory", "flight_dump")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +64,12 @@ class EventBus:
         self._subs: Tuple[Tuple[Optional[frozenset], Callable], ...] = ()
         self.published = 0
         self.sink_errors = 0
+        # Fleet rank tag (telemetry/fleet.py): when set (a plain dict,
+        # e.g. {"rank": 2, "ranks": 8}), every published event's data is
+        # merged over it, so per-rank JSONL streams are attributable
+        # offline. None (the single-process default) costs one attribute
+        # read per publish.  Emitter-provided keys win on collision.
+        self.rank_tag: Optional[Dict[str, object]] = None
 
     def subscribe(self, fn: Callable[[Event], None],
                   kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
@@ -90,6 +96,9 @@ class EventBus:
         subs = self._subs
         if not subs:
             return None
+        tag = self.rank_tag
+        if tag is not None:
+            data = {**tag, **data}
         ev = Event(kind, time.time(), data)
         delivered = False
         for kinds, fn in subs:
@@ -113,6 +122,38 @@ class EventBus:
             self._subs = ()
             self.published = 0
             self.sink_errors = 0
+            self.rank_tag = None
+
+
+def read_jsonl(path: str, on_torn: Optional[Callable[[str], None]] = None
+               ) -> list:
+    """Tolerant JSONL reader: parse every line of ``path`` that parses.
+
+    THE shared reader for event streams written by :class:`JsonlSink`
+    and friends (chaos soak, perf-regression gate, fleet aggregator —
+    one implementation, one torn-line policy).  A SIGKILL can tear a
+    line mid-write and the next attempt appends its first event onto
+    the fragment; such lines are skipped (reported via ``on_torn`` when
+    given) instead of crashing the verdict path.  A missing or
+    unreadable file reads as an empty stream — absence is the caller's
+    assertion to make, not an exception to catch.
+    """
+    out: list = []
+    try:
+        fh = open(path)
+    except OSError:
+        return out
+    with fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if on_torn is not None:
+                    on_torn(ln)
+    return out
 
 
 # -- sinks -------------------------------------------------------------------
@@ -212,7 +253,8 @@ class JsonlSink:
 class TensorBoardSink:
     """Bus -> TensorBoard bridge: skip/rollback/quarantine counts,
     goodput fractions, supervisor restarts, serve batch/span latencies,
-    and SLO attainment become scalars instead of being log-only.
+    device-memory gauges, and SLO attainment become scalars instead of
+    being log-only.
 
     Wraps an existing ``tpuic.metrics.tensorboard.TensorBoardWriter``
     (the MetricLogger's); subscribes to ``step`` only to track the
@@ -284,6 +326,20 @@ class TensorBoardSink:
                                  d.get("queue_ms", 0.0)),
                              serve_request_device_ms=float(
                                  d.get("device_ms", 0.0)))
+        elif ev.kind == "memory":
+            # Device-memory accounting (telemetry/memory.py): the
+            # aggregate gauges become scalars; the per-device split
+            # stays in JSONL/prom (a per-device TB curve per chip would
+            # be noise on a pod).
+            scalars = {}
+            for field in ("bytes_in_use", "peak_bytes_in_use",
+                          "process_rss_bytes"):
+                if d.get(field) is not None:
+                    scalars[f"memory_{field}"] = float(d[field])
+            if d.get("headroom_frac") is not None:
+                scalars["memory_headroom_frac"] = float(d["headroom_frac"])
+            if scalars:
+                self._tb.scalars(int(d.get("step", self._step)), **scalars)
         elif ev.kind == "slo":
             name = str(d.get("name", "slo"))
             scalars = {}

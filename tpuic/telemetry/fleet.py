@@ -1,0 +1,319 @@
+"""Per-rank fleet view: rank-tagged event streams + straggler attribution.
+
+Everything the telemetry layer measures (PRs 3/6) attributes one
+process's milliseconds; a data-parallel fleet lives or dies on the
+cross-rank question — *which rank* is slow, and how much fleet time its
+lateness costs.  The 15-minute-ImageNet line of work (arXiv 1711.04325,
+1511.00175) and every ZeRO-style scale-out (arXiv 2004.13336) treat
+straggler attribution as table stakes.  This module is that half:
+
+- **Rank tagging** (:func:`tag_bus_with_rank`): on a multi-process run
+  (``runtime/distributed.py``), every event published on the bus gains
+  ``rank``/``ranks`` fields — one dict merge at publish, nothing on
+  single-process runs (the tag stays ``None`` and publish is
+  unchanged).  Zero host syncs, zero compiles: the rank is two ints
+  read once at wiring time.
+- **Per-rank JSONL streams** (:func:`rank_stream_path`): rank 0 keeps
+  the configured ``--metrics-jsonl`` path (single-process back-compat);
+  rank k writes ``<stem>.rank<k>.jsonl`` next to it — on a shared
+  filesystem the fleet's whole event history lands in one directory
+  with no cross-process appends.
+- **Offline aggregator** (``python -m tpuic.telemetry.fleet <dir>``):
+  merges the streams (the shared tolerant ``events.read_jsonl``) and
+  computes the skew ledger over the steps every rank reported:
+  per-step cross-rank spread (max − min total_ms), the slowest-rank
+  histogram, and each rank's **estimated collective wait** — its step
+  time minus the fleet minimum for that step, summed.  In a
+  synchronous data-parallel step every other rank's device waits for
+  the slowest arrival, so a rank's excess over the fleet floor is the
+  stall it *exports* to the fleet; the rank with the dominant share is
+  the straggler verdict.
+
+Measurement caveat (documented, not hidden): the per-step events are
+HOST-side walls.  With the deferred drain at ``--log-every-steps 1``
+every host blocks on cross-rank metrics each step, so host step times
+equalize and the skew hides in each rank's ``device_ms`` residual.  At
+the production logging cadence (the default 50), hosts run free between
+drains and the per-step skew is visible — the fleet smoke
+(scripts/fleet_smoke.py) runs that way and proves a seeded
+``slow_step#`` rank is attributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_RANK_FILE_RE = re.compile(r"\.rank(\d+)\.[^.]+$")
+
+
+# -- rank tagging ------------------------------------------------------------
+# Launcher override: a fleet whose rank bookkeeping lives OUTSIDE
+# jax.distributed (independent per-rank workers, a launcher on a backend
+# without multiprocess collectives — this container's CPU jax, which the
+# CI fleet smoke runs on) declares itself via env.  The live runtime
+# (runtime/distributed.py) stays the default source.
+ENV_FLEET_RANK = "TPUIC_FLEET_RANK"
+ENV_FLEET_RANKS = "TPUIC_FLEET_RANKS"
+
+
+def tag_bus_with_rank(bus=None, rank: Optional[int] = None,
+                      ranks: Optional[int] = None) -> Tuple[int, int]:
+    """Tag ``bus`` (default: the process-global one) with this process's
+    (rank, world size): explicit arguments win, then the
+    ``TPUIC_FLEET_RANK``/``TPUIC_FLEET_RANKS`` launcher override, then
+    ``runtime/distributed.py``'s live process_index/process_count.
+    Returns the pair.  Single-process runs (``ranks == 1``) leave the
+    tag unset — the common path stays untouched and single-process
+    JSONL schemas don't grow fleet fields."""
+    if bus is None:
+        from tpuic.telemetry.events import bus as _bus
+        bus = _bus
+    if (rank is None) != (ranks is None):
+        # Same rule as the env override below: half a fleet identity is
+        # not an identity — silently rederiving both would drop the
+        # caller's value and can collapse every worker to rank 0/1.
+        raise ValueError(
+            f"tag_bus_with_rank: pass both rank and ranks or neither "
+            f"(got rank={rank!r}, ranks={ranks!r})")
+    if rank is None:
+        er = os.environ.get(ENV_FLEET_RANK)
+        ew = os.environ.get(ENV_FLEET_RANKS)
+        if (er is None) != (ew is None):
+            # A half-set override would silently collapse every worker
+            # to the runtime default (rank 0 of 1) — k processes then
+            # append interleaved, untagged events into ONE stream,
+            # exactly the corruption per-rank paths exist to prevent.
+            raise ValueError(
+                f"fleet launcher override is half-set: {ENV_FLEET_RANK}="
+                f"{er!r}, {ENV_FLEET_RANKS}={ew!r} — set both or neither")
+        if er is not None:
+            rank, ranks = int(er), int(ew)
+        else:
+            from tpuic.runtime.distributed import runtime_info
+            info = runtime_info()
+            rank, ranks = info.process_index, info.process_count
+    rank, ranks = int(rank), int(ranks)
+    bus.rank_tag = ({"rank": rank, "ranks": ranks} if ranks > 1 else None)
+    return rank, ranks
+
+
+def rank_stream_path(path: str, rank: int) -> str:
+    """Per-rank stream path: rank 0 keeps ``path`` (back-compat with
+    every single-process consumer); rank k gets ``<stem>.rank<k><ext>``
+    (``events.jsonl`` -> ``events.rank3.jsonl``)."""
+    if int(rank) == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{int(rank)}{ext or '.jsonl'}"
+
+
+# -- stream loading ----------------------------------------------------------
+def _infer_rank(path: str) -> Optional[int]:
+    m = _RANK_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_streams(paths: Sequence[str]) -> Dict[int, List[dict]]:
+    """Read JSONL event streams (files, or directories expanded to their
+    ``*.jsonl``) and group records by rank: the record's own ``rank``
+    field wins (the tagged streams), else the ``.rank<k>.`` filename
+    convention, else rank 0 — so pre-fleet single-process streams load
+    as a one-rank fleet instead of failing."""
+    from tpuic.telemetry.events import read_jsonl
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".jsonl")))
+        else:
+            files.append(p)
+    streams: Dict[int, List[dict]] = {}
+    for f in files:
+        fallback = _infer_rank(f)
+        for rec in read_jsonl(f):
+            r = rec.get("rank", fallback)
+            streams.setdefault(int(r) if r is not None else 0,
+                               []).append(rec)
+    return streams
+
+
+# -- the skew ledger ---------------------------------------------------------
+def aggregate(streams: Dict[int, List[dict]], warmup: int = 0) -> dict:
+    """Merge per-rank event streams into the straggler-attribution
+    report (module docstring).  ``warmup`` drops the first N common
+    steps (compile/cache warmup is per-rank noise, not skew signal —
+    the regress-gate convention).
+
+    Only steps reported by EVERY rank enter the skew math: a partial
+    step (one rank died mid-epoch) has no fleet-wide wall to compare.
+    """
+    from tpuic.metrics.meters import quantiles
+
+    ranks = sorted(streams)
+    per_step: Dict[int, Dict[int, dict]] = {}
+    step_counts = {r: 0 for r in ranks}
+    duplicates = {r: 0 for r in ranks}
+    for rank, recs in streams.items():
+        for rec in recs:
+            if rec.get("event") != "step":
+                continue
+            try:
+                step, total = int(rec["step"]), float(rec["total_ms"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            step_counts[rank] += 1
+            if rank in per_step.get(step, ()):
+                # A supervised restart replays steps into the same
+                # appended stream; last occurrence wins (the value that
+                # stuck), but the collapse is COUNTED and surfaced —
+                # mixed-attempt walls soften the skew math's meaning.
+                duplicates[rank] += 1
+            per_step.setdefault(step, {})[rank] = {
+                "total_ms": total,
+                "data_ms": float(rec.get("data_ms", 0.0) or 0.0),
+                "dispatch_ms": float(rec.get("dispatch_ms", 0.0) or 0.0),
+                "device_ms": float(rec.get("device_ms", 0.0) or 0.0),
+            }
+    common = sorted(s for s, by in per_step.items()
+                    if len(by) == len(ranks))[warmup:]
+    spreads: List[float] = []
+    slowest = {r: 0 for r in ranks}
+    excess = {r: 0.0 for r in ranks}
+    for s in common:
+        by = per_step[s]
+        totals = {r: by[r]["total_ms"] for r in ranks}
+        lo = min(totals.values())
+        spreads.append(max(totals.values()) - lo)
+        slowest[max(totals, key=totals.get)] += 1
+        for r, v in totals.items():
+            excess[r] += v - lo
+
+    per_rank = {}
+    for r in ranks:
+        row = {"steps": step_counts[r], "common_steps": len(common)}
+        totals = [per_step[s][r]["total_ms"] for s in common]
+        if totals:
+            q = quantiles(totals, (50, 99))
+            row.update(
+                mean_ms=round(sum(totals) / len(totals), 3),
+                p50_ms=round(q["p50"], 3), p99_ms=round(q["p99"], 3),
+                slowest_steps=slowest[r],
+                est_collective_wait_ms=round(excess[r], 3))
+            for phase in ("data_ms", "dispatch_ms", "device_ms"):
+                vals = [per_step[s][r][phase] for s in common]
+                row[f"mean_{phase}"] = round(sum(vals) / len(vals), 3)
+        per_rank[str(r)] = row
+
+    straggler = None
+    if common and len(ranks) >= 2:
+        worst = max(ranks, key=lambda r: excess[r])
+        total_excess = sum(excess.values())
+        straggler = {
+            "rank": worst,
+            "excess_share": (round(excess[worst] / total_excess, 4)
+                             if total_excess > 0 else 0.0),
+            "slowest_step_frac": round(slowest[worst] / len(common), 4),
+            "est_collective_wait_ms": round(excess[worst], 3),
+        }
+    out = {"ranks": ranks, "steps_common": len(common), "warmup": warmup,
+           "per_rank": per_rank, "straggler": straggler}
+    if any(duplicates.values()):
+        out["duplicate_steps"] = {str(r): n for r, n in duplicates.items()
+                                  if n}
+    if spreads:
+        q = quantiles(spreads, (50, 99))
+        out["spread_ms"] = {"p50": round(q["p50"], 3),
+                            "p99": round(q["p99"], 3),
+                            "max": round(max(spreads), 3)}
+    return out
+
+
+def summary_lines(report: dict) -> List[str]:
+    """Human rendering of :func:`aggregate` (the CLI's stdout)."""
+    lines = [f"[fleet] {len(report['ranks'])} rank(s), "
+             f"{report['steps_common']} common step(s)"
+             + (f" (warmup {report['warmup']} dropped)"
+                if report.get("warmup") else "")]
+    dup = report.get("duplicate_steps")
+    if dup:
+        lines.append(
+            f"[fleet] WARNING: duplicate step records (restart replays?) "
+            f"collapsed last-wins: {dup} — per-step walls may mix "
+            f"attempts; prefer per-attempt stream dirs for exact skew")
+    sp = report.get("spread_ms")
+    if sp:
+        lines.append(f"[fleet] per-step cross-rank spread: "
+                     f"p50 {sp['p50']:g} ms, p99 {sp['p99']:g} ms, "
+                     f"max {sp['max']:g} ms")
+    for r in report["ranks"]:
+        row = report["per_rank"][str(r)]
+        if "mean_ms" not in row:
+            lines.append(f"[fleet] rank {r}: {row['steps']} step event(s), "
+                         "none fleet-common")
+            continue
+        lines.append(
+            f"[fleet] rank {r}: p50 {row['p50_ms']:g} ms "
+            f"(data {row['mean_data_ms']:g} / dispatch "
+            f"{row['mean_dispatch_ms']:g} / device "
+            f"{row['mean_device_ms']:g}), slowest in "
+            f"{row['slowest_steps']}/{row['common_steps']} step(s), "
+            f"est collective wait {row['est_collective_wait_ms']:g} ms")
+    s = report.get("straggler")
+    if s:
+        lines.append(
+            f"[fleet] straggler: rank {s['rank']} — slowest in "
+            f"{100 * s['slowest_step_frac']:.0f}% of steps, "
+            f"{100 * s['excess_share']:.0f}% of fleet excess, "
+            f"~{s['est_collective_wait_ms']:g} ms exported stall")
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpuic.telemetry.fleet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="+",
+                   help="per-rank JSONL stream files, or directories "
+                        "whose *.jsonl are the fleet's streams")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="drop the first N common steps (compile/cache "
+                        "warmup is per-rank noise, not skew)")
+    p.add_argument("--json", default="",
+                   help="write the full report JSON here")
+    p.add_argument("--expect-straggler", type=int, default=None,
+                   help="exit 1 unless the straggler verdict names this "
+                        "rank (the CI fleet smoke's assertion)")
+    args = p.parse_args(argv)
+
+    streams = load_streams(args.paths)
+    if not streams:
+        print("[fleet] no event streams found", file=sys.stderr)
+        return 2
+    report = aggregate(streams, warmup=max(0, args.warmup))
+    for line in summary_lines(report):
+        print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"[fleet] report -> {args.json}")
+    if args.expect_straggler is not None:
+        s = report.get("straggler")
+        if s is None or int(s["rank"]) != args.expect_straggler:
+            print(f"[fleet] FAIL: expected straggler rank "
+                  f"{args.expect_straggler}, verdict is "
+                  f"{s and s['rank']}", file=sys.stderr)
+            return 1
+        print(f"[fleet] straggler verdict matches expected rank "
+              f"{args.expect_straggler}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

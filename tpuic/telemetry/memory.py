@@ -1,0 +1,235 @@
+"""Device-memory accounting: where does HBM go, per device, per step.
+
+The ROADMAP's multi-host and MFU items both need this gauge before they
+can move: weight-update sharding (arXiv:2004.13336) is *about* optimizer
+memory, and every "fit a bigger batch" experiment is a bet against an
+OOM that today only manifests as a crash.  This module samples
+per-device memory at step boundaries and publishes it as a ``memory``
+event on the telemetry bus, so HBM pressure is a curve in the JSONL /
+TensorBoard / Prometheus record instead of a post-mortem.
+
+Sources, in preference order (per device):
+
+- ``device.memory_stats()`` — the PJRT allocator's own counters
+  (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``), provided
+  by the TPU and GPU backends.  A pure host-side metadata read: it never
+  blocks on the device stream.
+- ``jax.live_arrays()`` fallback — the CPU backend returns no
+  ``memory_stats()``; summing the live arrays' ``nbytes`` per device is
+  an honest lower bound (arrays only; no allocator slack), labeled
+  ``source: "live_arrays"`` so dashboards don't read the two as the
+  same quantity.  No limit is known, so ``headroom_frac`` is omitted.
+
+Every sample also carries the process RSS (the shared
+``tpuic.metrics.meters.process_rss_bytes`` helper) — host-side leaks
+(pinned staging buffers, an unbounded queue) show up next to the device
+curve they eventually take down.
+
+Hot-loop discipline (the PR-2/PR-3 contract, checker-asserted in
+tests/test_fleet.py): sampling adds **zero host syncs and zero
+compiles** — ``memory_stats`` and the live-array walk are host-side
+metadata reads, RSS is a ``/proc`` read, and nothing here touches array
+*values*.  The ``jax.device_get`` count and the jit cache are identical
+with the sampler on vs. off.
+
+Low-headroom warning: the first sample that sees any device's
+``headroom_frac`` under ``warn_headroom_frac`` carries
+``warning: "low_headroom"`` (and logs one line) — a one-shot latch, so
+a run hovering at 95% HBM warns once instead of once per step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tpuic.metrics.meters import process_rss_bytes
+
+# memory_stats() key -> event field.  PJRT backends agree on these names
+# (tpu/gpu); anything absent is simply omitted from the sample.
+_STAT_FIELDS = (("bytes_in_use", "bytes_in_use"),
+                ("peak_bytes_in_use", "peak_bytes_in_use"),
+                ("bytes_limit", "bytes_limit"))
+
+
+def _device_label(dev) -> str:
+    return str(getattr(dev, "id", dev))
+
+
+def _stats_sample(dev) -> Optional[dict]:
+    """One device's allocator counters via ``memory_stats()``; None when
+    the backend provides none (CPU) or the call is unavailable."""
+    stats_fn = getattr(dev, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        stats = stats_fn()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {"device": _device_label(dev),
+           "kind": str(getattr(dev, "device_kind", "unknown"))}
+    for src, field in _STAT_FIELDS:
+        v = stats.get(src)
+        if v is not None:
+            out[field] = int(v)
+    if out.get("bytes_limit") and out.get("bytes_in_use") is not None:
+        out["headroom_frac"] = round(
+            1.0 - out["bytes_in_use"] / out["bytes_limit"], 4)
+    return out if "bytes_in_use" in out else None
+
+
+def _live_array_sample(devices) -> tuple:
+    """CPU fallback: per-device sum of live jax.Array nbytes.  An array
+    sharded over k devices is charged nbytes/k to each.  Host-side walk
+    of the liveness registry — no device work, no syncs — but O(live
+    arrays): the sampler auto-throttles its cadence when the registry
+    is large (see ``MemorySampler``).  Returns (rows, n_arrays)."""
+    import jax
+
+    per_dev = {_device_label(d): 0.0 for d in devices}
+    kinds = {_device_label(d): str(getattr(d, "device_kind", "cpu"))
+             for d in devices}
+    try:
+        live = jax.live_arrays()
+    except Exception:
+        live = ()
+    for arr in live:
+        try:
+            devs = list(arr.devices())
+            share = arr.nbytes / max(1, len(devs))
+        except Exception:
+            continue  # deleted/donated under us — racing is fine, skip
+        for d in devs:
+            label = _device_label(d)
+            if label in per_dev:
+                per_dev[label] += share
+    return ([{"device": label, "kind": kinds[label],
+              "bytes_in_use": int(n)} for label, n in per_dev.items()],
+            len(live))
+
+
+class MemorySampler:
+    """Samples per-device memory and publishes ``memory`` events.
+
+    Wired by ``TrainTelemetry`` as a bus subscriber on ``step`` events
+    (one sample per ``every`` step boundaries, default every step —
+    the ``memory_stats`` read is microseconds of host metadata, and the
+    O(live-arrays) CPU fallback auto-throttles its cadence on large
+    liveness registries), and called directly at scrape time by the
+    serve driver's Prometheus collector.  The last
+    sample is kept for :meth:`snapshot` so the prom exposition renders
+    ``device_memory_bytes{device,kind}`` rows without re-sampling.
+    """
+
+    def __init__(self, publish=None, devices=None, every: int = 1,
+                 warn_headroom_frac: float = 0.05, log=None,
+                 fallback_throttle_arrays: int = 1024,
+                 fallback_stride: int = 8) -> None:
+        if publish is None:
+            from tpuic.telemetry.events import bus as _bus
+            publish = _bus.publish
+        self._publish = publish
+        self._devices = devices
+        self._every = max(1, int(every))
+        self._warn_frac = float(warn_headroom_frac)
+        self._log = log
+        self._lock = threading.Lock()
+        self._warned = False
+        self._seen_steps = 0
+        # The live_arrays fallback is O(live arrays) per sample — fine
+        # for the small-model CPU runs it exists for, but a huge
+        # param/opt tree would pay a real per-step walk.  Once a walk
+        # sees more than ``fallback_throttle_arrays`` arrays, step-
+        # boundary sampling strides by ``fallback_stride`` (direct
+        # sample() calls are never throttled; the memory_stats path is
+        # one cheap allocator read and never throttles either).
+        self._fb_throttle = int(fallback_throttle_arrays)
+        self._fb_stride = max(1, int(fallback_stride))
+        self._stride = 1
+        self.samples = 0
+        self.last: Optional[dict] = None
+
+    def _resolve_devices(self):
+        if self._devices is None:
+            import jax
+            self._devices = jax.local_devices()
+        return self._devices
+
+    # -- bus hook (TrainTelemetry subscribes this for 'step') -----------
+    def on_event(self, ev) -> None:
+        self._seen_steps += 1
+        if (self._seen_steps - 1) % (self._every * self._stride):
+            return
+        self.sample(step=ev.data.get("step"))
+
+    # -- the sample -----------------------------------------------------
+    def sample(self, step=None) -> Optional[dict]:
+        """Take one sample, publish it as a ``memory`` event, return it
+        (None when no device yields anything — never raises into the
+        loop: memory accounting must not take down the run)."""
+        try:
+            devices = self._resolve_devices()
+        except Exception:
+            return None
+        rows = []
+        source = "memory_stats"
+        for dev in devices:
+            row = _stats_sample(dev)
+            if row is not None:
+                rows.append(row)
+        if not rows:
+            source = "live_arrays"
+            rows, n_live = _live_array_sample(devices)
+            if n_live > self._fb_throttle:
+                self._stride = self._fb_stride
+        if not rows:
+            return None
+        out = {"source": source, "devices": rows}
+        if step is not None:
+            out["step"] = int(step)
+        out["bytes_in_use"] = sum(r.get("bytes_in_use", 0) for r in rows)
+        peaks = [r["peak_bytes_in_use"] for r in rows
+                 if r.get("peak_bytes_in_use") is not None]
+        if peaks:
+            out["peak_bytes_in_use"] = sum(peaks)
+        limits = [r["bytes_limit"] for r in rows
+                  if r.get("bytes_limit") is not None]
+        if limits:
+            out["bytes_limit"] = sum(limits)
+        headrooms = [r["headroom_frac"] for r in rows
+                     if r.get("headroom_frac") is not None]
+        if headrooms:
+            # The aggregate headroom is the WORST device's: one full
+            # chip OOMs the step regardless of the others' slack.
+            out["headroom_frac"] = min(headrooms)
+        rss = process_rss_bytes()
+        if rss is not None:
+            out["process_rss_bytes"] = int(rss)
+        with self._lock:
+            warn = (not self._warned and headrooms
+                    and min(headrooms) < self._warn_frac)
+            if warn:
+                self._warned = True
+            self.samples += 1
+            self.last = out
+        if warn:
+            worst = min((r for r in rows
+                         if r.get("headroom_frac") is not None),
+                        key=lambda r: r["headroom_frac"])
+            out["warning"] = "low_headroom"
+            if self._log is not None:
+                self._log(
+                    f"[memory] LOW HEADROOM: device {worst['device']} "
+                    f"({worst['kind']}) at "
+                    f"{100 * (1 - worst['headroom_frac']):.1f}% of "
+                    f"{worst.get('bytes_limit', 0) / 2**30:.2f} GiB — "
+                    f"the next allocation spike is an OOM")
+        self._publish("memory", **out)
+        return out
+
+    def snapshot(self) -> Optional[dict]:
+        """The most recent sample (for the Prometheus expositions)."""
+        with self._lock:
+            return self.last

@@ -82,6 +82,42 @@ def slo_rows(slo_report: Optional[dict]) -> List[Tuple]:
     return rows
 
 
+def memory_rows(memory: Optional[dict]) -> List[Tuple]:
+    """MemorySampler.snapshot() -> exposition rows (telemetry/memory.py):
+    per device, ``device_memory_bytes{device,kind}`` with kind in
+    ``in_use|peak|limit`` plus a per-device headroom gauge — the HBM
+    curve the multi-host/MFU roadmap items steer by.  Shared by the
+    serve and train expositions; None renders nothing (CPU runs with no
+    sample yet must not scrape as 0 bytes)."""
+    rows: List[Tuple] = []
+    for dev in (memory or {}).get("devices") or ():
+        labels = {"device": str(dev.get("device", "?"))}
+        for field, kind in (("bytes_in_use", "in_use"),
+                            ("peak_bytes_in_use", "peak"),
+                            ("bytes_limit", "limit")):
+            if dev.get(field) is not None:
+                rows.append(("device_memory_bytes", dev[field], "gauge",
+                             "per-device memory bytes by kind "
+                             "(in_use|peak|limit); source per "
+                             "docs/observability.md 'Device memory'",
+                             {**labels, "kind": kind}))
+        if dev.get("headroom_frac") is not None:
+            rows.append(("device_memory_headroom_frac",
+                         dev["headroom_frac"], "gauge",
+                         "1 - in_use/limit per device (alert low: the "
+                         "next allocation spike is an OOM)", labels))
+    return rows
+
+
+def _process_rss_row() -> Tuple:
+    """The ``process_rss_bytes`` gauge both expositions render — host
+    memory next to the device curve it eventually takes down.  Lazy
+    import keeps this module importable without the metrics stack."""
+    from tpuic.metrics.meters import process_rss_bytes
+    return ("process_rss_bytes", process_rss_bytes(), "gauge",
+            "resident set size of this process", None)
+
+
 def admission_rows(snapshot: dict,
                    admission: Optional[dict] = None) -> List[Tuple]:
     """The admission-control exposition (docs/serving.md, "Admission
@@ -122,7 +158,8 @@ def admission_rows(snapshot: dict,
 def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
                      heartbeat_age_s: Optional[float] = None,
                      slo: Optional[dict] = None,
-                     admission: Optional[dict] = None) -> str:
+                     admission: Optional[dict] = None,
+                     memory: Optional[dict] = None) -> str:
     """ServeStats.snapshot() -> Prometheus text.
 
     ``heartbeat_age_s``: seconds since the supervised-liveness heartbeat
@@ -132,8 +169,11 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     ``slo``: an SLOTracker.report() to append (telemetry/slo.py).
     ``admission``: an AdmissionController.state() for brownout/quota
     gauges; the rejected_total{cause,priority} split renders from the
-    snapshot itself."""
+    snapshot itself.
+    ``memory``: a MemorySampler.snapshot() for the per-device
+    ``device_memory_bytes{device,kind}`` rows (telemetry/memory.py)."""
     rows: List[Tuple] = [
+        _process_rss_row(),
         ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
          "seconds since the liveness heartbeat file was last written "
          "(supervised runs only)", None),
@@ -177,6 +217,7 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
         rows.append(("batches_total", n, "counter",
                      "device calls per padding bucket", {"bucket": bucket}))
     rows.extend(admission_rows(snapshot, admission))
+    rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)
 
@@ -184,14 +225,17 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
 def train_exposition(report: dict, steptime: Optional[dict] = None,
                      prefix: str = "tpuic_train",
                      heartbeat_age_s: Optional[float] = None,
-                     slo: Optional[dict] = None) -> str:
+                     slo: Optional[dict] = None,
+                     memory: Optional[dict] = None) -> str:
     """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text.
 
     ``heartbeat_age_s`` as in :func:`serve_exposition`; ``restart_count``
     comes from the report's ``restarts`` field (the supervisor restart
     this process announced at fit() start — runtime/supervisor.py).
-    ``slo``: an SLOTracker.report() for the step-time objectives."""
+    ``slo``: an SLOTracker.report() for the step-time objectives.
+    ``memory``: a MemorySampler.snapshot() (telemetry/memory.py)."""
     rows: List[Tuple] = [
+        _process_rss_row(),
         ("restart_count", report.get("restarts"), "counter",
          "supervisor restarts absorbed by this run "
          "(runtime/supervisor.py exit-code contract)", None),
@@ -223,6 +267,7 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
             rows.append((name, v, "gauge",
                          "step-time percentiles over the sliding window",
                          {"quantile": q}))
+    rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)
 

@@ -150,7 +150,12 @@ def _train_workload_in(work: str, steps: int,
         raise RuntimeError(
             f"train workload exited {proc.returncode}:\n"
             f"{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}")
-    recs = [json.loads(ln) for ln in open(jsonl)]
+    # The shared tolerant reader (telemetry/events.read_jsonl): a child
+    # killed by the timeout can leave a torn tail line; the gate should
+    # then fail on its own "telemetry incomplete" diagnosis below, not
+    # on a JSONDecodeError.
+    from tpuic.telemetry.events import read_jsonl
+    recs = read_jsonl(jsonl)
     step_evs = [r for r in recs if r["event"] == "step"]
     finals = [r for r in recs if r["event"] == "goodput" and r.get("final")]
     if len(finals) != 1 or len(step_evs) < 4:

@@ -288,14 +288,21 @@ def config_from_args(args: argparse.Namespace) -> Config:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # Supervision protocol (runtime/supervisor.py, docs/robustness.md):
-    # register the SIGQUIT faulthandler stack dump FIRST — a hang
-    # anywhere after this line, including inside the backend probe or
-    # the first compile, must still be explainable when the supervisor's
-    # watchdog escalates. Stdlib-only, costs nothing unsupervised.
+    # register the SIGQUIT handlers FIRST — a hang anywhere after this
+    # line, including inside the backend probe or the first compile,
+    # must still be explainable when the supervisor's watchdog
+    # escalates. The flight recorder (telemetry/flight.py) registers
+    # its Python-level dump BEFORE the faulthandler stack dump, which
+    # chains into it: one SIGQUIT yields stacks + the event timeline
+    # leading into the wedge. Costs nothing unsupervised (no
+    # TPUIC_FLIGHT_DUMP -> no recorder, chain=False as before); the
+    # import pulls no backend init — only the guard below may do that.
     from tpuic.runtime.supervisor import (EXIT_POISON, EXIT_PREEMPTED,
                                           NonRetryableError,
                                           install_stack_dump_handler)
-    install_stack_dump_handler()
+    from tpuic.telemetry.flight import install_flight_recorder
+    flight = install_flight_recorder()
+    install_stack_dump_handler(chain=flight is not None)
     # Dev-image guard: probe the tunneled TPU backend (whose init HANGS,
     # not errors, when the tunnel is down) and fall back to CPU with a
     # message instead of hanging the training command.
@@ -337,7 +344,8 @@ def main(argv=None) -> int:
                     dict(ev.data),
                     trainer.telemetry.steptime.summary(),
                     heartbeat_age_s=hb.age_s() if hb is not None else None,
-                    slo=slo.report() if slo is not None else None))
+                    slo=slo.report() if slo is not None else None,
+                    memory=trainer.telemetry.memory.snapshot()))
             subscribe(_prom_dump, kinds=("goodput",))
     try:
         best = trainer.fit()
