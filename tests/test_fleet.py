@@ -478,6 +478,27 @@ def test_load_streams_rank_sources_and_cli(tmp_path):
     assert fleet.main([str(empty)]) == 2
 
 
+def test_cli_require_ranks_gates_missing_streams(tmp_path, capsys):
+    """--require-ranks N (the gang soak's fleet-coverage gate): a rank
+    whose stream is missing entirely must fail the aggregation loudly,
+    not have the skew silently computed over the ranks that showed up."""
+    from tpuic.telemetry import fleet
+    d = tmp_path / "fleet"
+    d.mkdir()
+    for rank in (0, 1):
+        name = "events.jsonl" if rank == 0 else f"events.rank{rank}.jsonl"
+        with open(d / name, "w") as f:
+            for r in _stream(rank, [100.0] * 4):
+                f.write(json.dumps(r) + "\n")
+    assert fleet.main([str(d), "--require-ranks", "2"]) == 0
+    # Rank 2's stream never arrived: exit 1 naming the missing rank.
+    assert fleet.main([str(d), "--require-ranks", "3"]) == 1
+    assert "missing rank stream(s) [2]" in capsys.readouterr().err
+    # More ranks than expected is just as loud (misconfigured N).
+    assert fleet.main([str(d), "--require-ranks", "1"]) == 1
+    assert "unexpected rank(s) [1]" in capsys.readouterr().err
+
+
 # -- prometheus rows ---------------------------------------------------------
 def test_prom_memory_and_rss_rows():
     from tpuic.telemetry.goodput import GoodputTracker

@@ -1,0 +1,561 @@
+"""Gang supervisor (ISSUE 10): coordinated multi-rank restart, partial
+failure recovery, fleet-agreed resume — plus the satellites that ride
+along (the env rendezvous contract in runtime/distributed.py, the
+``TPUIC_RESUME_STEP`` cap in the checkpoint ladder, the rank-targeted
+fault points, the fleet aggregator's ``--require-ranks``).
+
+Like tests/test_supervisor.py, gang tests run REAL child processes but
+the children import only ``tpuic.runtime.supervisor`` (stdlib-only), so
+an attempt costs a bare interpreter start. The full-fat end-to-end
+(real train.py ranks, real crash, bitwise baseline race) is
+``scripts/gang_soak.py``, CI-gated next to this suite."""
+
+import json
+import os
+import signal
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tpuic.runtime.gang import (GangSupervisor, committed_steps,
+                                fleet_resume_step, rank_path)
+from tpuic.runtime.supervisor import (ENV_RESUME_STEP, EXIT_CRASH_LOOP,
+                                      EXIT_POISON, EXIT_PREEMPTED,
+                                      read_heartbeat)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Rank-aware child prelude: the real HeartbeatWriter on the per-rank
+# heartbeat file the gang assigned, rank identity from the fleet env.
+_CHILD_PRELUDE = textwrap.dedent("""\
+    import os, signal, sys, time
+    from tpuic.runtime.supervisor import (EXIT_PREEMPTED, EXIT_POISON,
+                                          HeartbeatWriter)
+    hb = HeartbeatWriter(os.environ["TPUIC_HEARTBEAT_FILE"],
+                         min_interval_s=0.0)
+    attempt = int(os.environ.get("TPUIC_RESTART", "0"))
+    rank = int(os.environ.get("TPUIC_FLEET_RANK", "0"))
+    def beat(step):
+        hb.last_step = step
+        hb.beat()
+    def flush_on_term():
+        # The PR-2 preemption-flush shape: SIGTERM -> exit 43.
+        signal.signal(signal.SIGTERM, lambda s, f: sys.exit(EXIT_PREEMPTED))
+    def await_peers(n=2, timeout=30.0):
+        # Rendezvous: wait until EVERY rank's heartbeat file exists, so a
+        # rank crashing immediately can't race a slower-starting peer out
+        # of its first beat (the teardown TERM would land mid-import and
+        # record no step at all — a load-dependent flake, not a gang
+        # semantic).
+        base = os.environ["TPUIC_HEARTBEAT_FILE"]
+        stem = base.replace(".rank%d" % rank if rank else "", "")
+        root, ext = os.path.splitext(stem)
+        paths = [stem if k == 0 else "%s.rank%d%s" % (root, k, ext)
+                 for k in range(n)]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(os.path.exists(p) for p in paths):
+                return
+            time.sleep(0.02)
+""")
+
+
+def _child(tmp_path, body: str) -> list:
+    path = os.path.join(str(tmp_path), "child.py")
+    with open(path, "w") as f:
+        f.write(_CHILD_PRELUDE + textwrap.dedent(body))
+    return [sys.executable, path]
+
+
+def _gang(tmp_path, cmd, ranks=2, **kw) -> GangSupervisor:
+    kw.setdefault("watchdog_s", 30.0)
+    kw.setdefault("startup_grace_s", 60.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 10.0)
+    kw.setdefault("backoff_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.05)
+    kw.setdefault("env", {"PYTHONPATH": REPO})
+    return GangSupervisor(cmd, os.path.join(str(tmp_path), "state"),
+                          ranks=ranks, **kw)
+
+
+def _ledger(sup) -> list:
+    return [json.loads(ln) for ln in open(sup.ledger_file)]
+
+
+# -- rank-path convention ----------------------------------------------------
+def test_rank_path_matches_fleet_stream_convention():
+    """gang.rank_path is a stdlib-only copy of fleet.rank_stream_path
+    (the parent must not import telemetry) — pin the two equal so the
+    convention can never drift apart silently."""
+    from tpuic.telemetry.fleet import rank_stream_path
+    for path in ("/a/b/events.jsonl", "heartbeat.json", "/x/noext"):
+        for rank in (0, 1, 7):
+            assert rank_path(path, rank) == rank_stream_path(path, rank)
+
+
+# -- gang lifecycle ----------------------------------------------------------
+def test_gang_all_ranks_done(tmp_path):
+    sup = _gang(tmp_path, _child(tmp_path, """
+        beat(3 + rank)
+        sys.exit(0)
+    """))
+    assert sup.run() == 0
+    assert sup.restarts == 0 and len(sup.attempts) == 1
+    res = sup.attempts[0]
+    assert res.codes == [0, 0] and res.outcome == "done"
+    assert res.last_steps == [3, 4] and res.fleet_step == 3
+    # Per-rank heartbeat files at the fleet convention paths.
+    assert read_heartbeat(os.path.join(sup.state_dir,
+                                       "heartbeat.json"))["step"] == 3
+    assert read_heartbeat(os.path.join(sup.state_dir,
+                                       "heartbeat.rank1.json"))["step"] == 4
+
+
+def test_single_rank_crash_tears_down_gang_with_flush_window(tmp_path):
+    """The tentpole semantics: rank 1 dying retryable tears the whole
+    gang down — the survivor gets its SIGTERM flush window (exits 43,
+    the contract's clean-flush code) — and ALL ranks restart together."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        if attempt == 0 and rank == 1:
+            beat(2)
+            await_peers()        # peer registered + beat before the crash
+            os._exit(1)          # the partial failure
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            beat(5 if attempt else 3)
+            time.sleep(0.02)
+            if attempt == 1:
+                sys.exit(0)      # second life completes
+    """))
+    assert sup.run() == 0
+    assert sup.restarts == 1 and sup.crash_restarts == 1
+    assert len(sup.attempts) == 2
+    first = sup.attempts[0]
+    assert first.outcome == "retryable"
+    assert first.codes[1] == 1            # the crashed rank
+    assert first.codes[0] == EXIT_PREEMPTED  # survivor flushed in the window
+    events = [r["event"] for r in _ledger(sup)]
+    assert "teardown" in events and events.count("spawn") == 4
+    td = [r for r in _ledger(sup) if r["event"] == "teardown"][0]
+    assert td["why"] == "retryable" and td["rank"] == 1
+
+
+def test_poison_from_any_rank_stops_the_gang(tmp_path):
+    """Exit 44 from one rank is a deterministic failure N restarts can't
+    fix: survivors still get their flush window, but nothing restarts."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        if rank == 1:
+            beat(1)
+            await_peers()        # survivor's TERM handler is armed
+            sys.exit(EXIT_POISON)
+        while True:
+            beat(1)
+            time.sleep(0.02)
+    """))
+    assert sup.run() == EXIT_POISON
+    assert sup.restarts == 0 and len(sup.attempts) == 1
+    res = sup.attempts[0]
+    assert res.codes[1] == EXIT_POISON and res.codes[0] == EXIT_PREEMPTED
+    assert _ledger(sup)[-1]["event"] == "giveup"
+
+
+def test_gang_preemption_flush_restarts_free(tmp_path):
+    """A whole-fleet eviction (every rank exits 43) restarts immediately
+    and consumes none of the retryable budget — the single supervisor's
+    contract, gang-wide."""
+    # Rank 0 flushes on its own (the scheduler's TERM reached it first);
+    # rank 1 flushes via the gang's teardown TERM — the two eviction
+    # arrival orders a real fleet sees. (Both ranks racing their OWN
+    # sys.exit(43) against the teardown TERM would reintroduce the
+    # finalization-window kill the one-TERM-per-pid guard exists for —
+    # the parent cannot know a child is already mid-exit.)
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        if attempt == 0:
+            beat(2)
+            await_peers()
+            if rank == 0:
+                sys.exit(EXIT_PREEMPTED)
+            while True:
+                beat(2)
+                time.sleep(0.02)
+        beat(4)
+        sys.exit(0)
+    """), max_restarts=0)
+    assert sup.run() == 0
+    assert sup.restarts == 1 and sup.crash_restarts == 0
+    assert sup.attempts[0].outcome == "preempted"
+
+
+def test_fleet_min_progress_one_healthy_rank_cannot_mask(tmp_path):
+    """The gang-wide crash-loop currency is the FLEET-MIN best step:
+    rank 0 advancing every attempt must not mask rank 1 stuck at the
+    same step — the no-progress streak trips the crash-loop verdict."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        beat(10 + attempt if rank == 0 else 1)   # rank 1 never advances
+        await_peers()   # both beats on disk before either rank dies
+        os._exit(1)
+    """), crash_loop_k=2, max_restarts=10)
+    assert sup.run() == EXIT_CRASH_LOOP
+    # Attempt 0 establishes the fleet baseline (min step 1); the next
+    # TWO attempts advance rank 0 but never the fleet min — streak trips.
+    assert len(sup.attempts) == 3 and sup.restarts == 2
+    assert sup.best_steps[0] == 12 and sup.best_steps[1] == 1
+    assert sup.best_fleet_step == 1
+    give = _ledger(sup)[-1]
+    assert give["event"] == "giveup" and "crash loop" in give["reason"]
+
+
+def test_fleet_min_progress_resets_streak(tmp_path):
+    """Both ranks advancing the fleet min IS progress — the streak
+    resets and the budget (not the crash-loop verdict) is what bounds
+    repeated crashes."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        beat(10 * (attempt + 1) + rank)
+        if attempt < 2:
+            await_peers()
+            os._exit(1)
+        sys.exit(0)
+    """), crash_loop_k=2, max_restarts=10)
+    assert sup.run() == 0
+    assert sup.restarts == 2 and sup.crash_restarts == 2
+    assert sup.best_fleet_step == 30
+
+
+def test_hang_is_rank_attributed_and_tears_down(tmp_path):
+    """A wedged rank trips ITS watchdog: rank-attributed hang ledger
+    record, per-rank stack-dump artifact, escalation on that rank only,
+    then coordinated teardown (survivor flushes 43)."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        from tpuic.runtime.supervisor import install_stack_dump_handler
+        install_stack_dump_handler()
+        flush_on_term()
+        if rank == 1:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            beat(1)
+            await_peers()         # survivor is up before the wedge starts
+            while True:
+                time.sleep(0.2)   # wedged: beats stop
+        while True:
+            beat(2)
+            time.sleep(0.02)
+    """), watchdog_s=0.6, quit_wait_s=1.5, grace_s=1.0, max_restarts=0)
+    assert sup.run() == EXIT_CRASH_LOOP  # budget 0: report, don't retry
+    (res,) = sup.attempts
+    assert res.hung_ranks == [1] and res.outcome == "retryable"
+    assert res.codes[0] == EXIT_PREEMPTED  # the healthy rank flushed
+    hangs = [r for r in _ledger(sup) if r["event"] == "hang"]
+    assert len(hangs) == 1 and hangs[0]["rank"] == 1
+    dump = os.path.join(sup.state_dir, "stackdump-0.rank1.txt")
+    assert "File" in open(dump).read()
+
+
+def test_poison_during_hang_teardown_still_stops_the_gang(tmp_path):
+    """Outcome precedence: a rank reporting 44 while the gang is being
+    torn down for a DIFFERENT rank's hang is still poison — the gang
+    must stop (documented contract: poison from ANY rank stops it), not
+    book the attempt as a retryable hang and restart a deterministically
+    poisoned fleet."""
+    sup = _gang(tmp_path, _child(tmp_path, """
+        if rank == 0:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            beat(1)
+            await_peers()
+            while True:
+                time.sleep(0.2)   # wedged: the watchdog trips on rank 0
+        signal.signal(signal.SIGTERM,
+                      lambda s, f: sys.exit(EXIT_POISON))
+        while True:
+            beat(1)
+            time.sleep(0.02)
+    """), watchdog_s=0.6, quit_wait_s=1.0, grace_s=1.0, max_restarts=10)
+    assert sup.run() == EXIT_POISON
+    assert sup.restarts == 0 and len(sup.attempts) == 1
+    (res,) = sup.attempts
+    assert res.hung_ranks == [0] and res.outcome == "poison"
+    assert res.codes[1] == EXIT_POISON
+
+
+def test_gang_shutdown_shared_eviction(tmp_path):
+    """SIGTERM to the gang supervisor forwards ONE flush-window TERM to
+    every rank; all flush 43 and the supervisor exits 43 itself."""
+    import threading
+    sup = _gang(tmp_path, _child(tmp_path, """
+        flush_on_term()
+        while True:
+            beat(1)
+            time.sleep(0.02)
+    """))
+    code = {}
+    runner = threading.Thread(target=lambda: code.setdefault(
+        "rc", sup.run()))
+    runner.start()
+    hbs = [os.path.join(sup.state_dir, "heartbeat.json"),
+           os.path.join(sup.state_dir, "heartbeat.rank1.json")]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and any(
+            read_heartbeat(p) is None for p in hbs):
+        time.sleep(0.05)
+    assert all(read_heartbeat(p) is not None for p in hbs), \
+        "a rank never heartbeated"
+    sup._on_signal(signal.SIGTERM, None)
+    runner.join(timeout=30)
+    assert not runner.is_alive()
+    assert code["rc"] == EXIT_PREEMPTED
+    assert sup.attempts[0].codes == [EXIT_PREEMPTED, EXIT_PREEMPTED]
+
+
+# -- fleet-agreed resume -----------------------------------------------------
+def _write_manifest(d, track, step):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, track + ".manifest.json"), "w") as f:
+        json.dump({"version": 1, "step": step, "files": {}}, f)
+
+
+def test_committed_steps_and_fleet_resume_step(tmp_path):
+    r0 = str(tmp_path / "cp0" / "model")
+    r1 = str(tmp_path / "cp1" / "model")
+    _write_manifest(r0, "latest", 9)     # survivor's mid-teardown flush
+    _write_manifest(r0, "latest.prev", 6)
+    _write_manifest(r0, "best", 6)
+    _write_manifest(r1, "latest", 6)     # crashed rank's last commit
+    assert committed_steps(r0) == {"latest": 9, "latest.prev": 6, "best": 6}
+    # The newest step EVERY rank committed: 6, not the survivor's 9.
+    assert fleet_resume_step([r0, r1]) == 6
+    # No common step: fall back to the slowest rank's newest commit.
+    _write_manifest(r1, "latest", 5)
+    assert fleet_resume_step([r0, r1]) == 5
+    # A rank with no committed manifest at all -> nothing to agree on.
+    assert fleet_resume_step([r0, str(tmp_path / "empty")]) is None
+    assert fleet_resume_step([]) is None
+
+
+def test_gang_restart_passes_fleet_resume_env(tmp_path):
+    """On a gang restart the agreed step rides TPUIC_RESUME_STEP into
+    every rank (and the gang_resume ledger records it); attempt 0 runs
+    without the cap."""
+    for k, steps in ((0, {"latest": 9, "best": 6}), (1, {"latest": 6})):
+        for track, s in steps.items():
+            _write_manifest(str(tmp_path / f"cp{k}" / "m"), track, s)
+    sup = _gang(tmp_path, _child(tmp_path, """
+        out = os.path.join(os.path.dirname(__file__),
+                           f"env.{attempt}.{rank}")
+        with open(out, "w") as f:
+            f.write(os.environ.get("TPUIC_RESUME_STEP", "<unset>"))
+        beat(6 + attempt)
+        sys.exit(0 if attempt else 1)
+    """), ckpt_dirs=str(tmp_path / "cp{rank}" / "m"))
+    assert sup.run() == 0
+    assert sup.restarts == 1 and sup.last_resume_step == 6
+    for rank in (0, 1):
+        assert open(str(tmp_path / f"env.0.{rank}")).read() == "<unset>"
+        assert open(str(tmp_path / f"env.1.{rank}")).read() == "6"
+    resume = [r for r in _ledger(sup) if r["event"] == "gang_resume"]
+    assert len(resume) == 1 and resume[0]["step"] == 6
+
+
+def test_spawn_env_rank_identity_and_rendezvous(tmp_path):
+    """One rank-identity source: TPUIC_FLEET_RANK(S) always; the full
+    jax.distributed trio only when a coordinator is configured (on a
+    collective-less CPU fleet the trio would wedge initialize())."""
+    sup = _gang(tmp_path, ["true"], ranks=3)
+    env = sup._spawn_env(2, 1, 0.0, resume_step=None)
+    assert env["TPUIC_FLEET_RANK"] == "1"
+    assert env["TPUIC_FLEET_RANKS"] == "3"
+    assert env["TPUIC_RESTART"] == "2"
+    assert "TPUIC_COORDINATOR_ADDRESS" not in env
+    assert "TPUIC_PROCESS_ID" not in env
+    assert ENV_RESUME_STEP not in env
+    sup2 = _gang(tmp_path, ["true"], ranks=3, coordinator="host:1234")
+    env2 = sup2._spawn_env(0, 2, 0.0, resume_step=7)
+    assert env2["TPUIC_COORDINATOR_ADDRESS"] == "host:1234"
+    assert env2["TPUIC_NUM_PROCESSES"] == "3"
+    assert env2["TPUIC_PROCESS_ID"] == "2"
+    assert env2[ENV_RESUME_STEP] == "7"
+
+
+def test_rank_cmd_template_substitution(tmp_path):
+    sup = _gang(tmp_path, ["python", "train.py", "--ckpt-dir",
+                           "/w/cp{rank}"], ranks=2)
+    assert sup._rank_cmd(0)[-1] == "/w/cp0"
+    assert sup._rank_cmd(1)[-1] == "/w/cp1"
+
+
+# -- the supervise CLI -------------------------------------------------------
+def test_supervise_cli_gang_end_to_end(tmp_path):
+    """--gang N through the real CLI: {rank} substitution reaches the
+    children, and a clean gang exits 0."""
+    from tpuic.supervise import main
+    marker = os.path.join(str(tmp_path), "rank{rank}.txt")
+    rc = main(["--state-dir", str(tmp_path / "state"), "--gang", "2",
+               "--startup-grace-s", "60", "--poll-s", "0.05", "--",
+               sys.executable, "-c",
+               f"open(r'{marker}'.replace('{{rank}}', "
+               "__import__('os').environ['TPUIC_FLEET_RANK']), 'w')"
+               ".write('ok')"])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "rank0.txt"))
+    assert os.path.exists(str(tmp_path / "rank1.txt"))
+
+
+# -- satellite: checkpoint resume cap ----------------------------------------
+@pytest.fixture
+def _resume_env(monkeypatch):
+    monkeypatch.delenv(ENV_RESUME_STEP, raising=False)
+    return monkeypatch
+
+
+def test_restore_honors_fleet_resume_cap(tmp_path, _resume_env):
+    """TPUIC_RESUME_STEP caps the integrity ladder: rungs committed
+    AHEAD of the fleet-agreed step are skipped, so a survivor whose
+    teardown flush outran the fleet replays from the agreed step
+    instead of resuming ahead of its peers."""
+    import numpy as np
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tests.test_checkpoint import _state
+
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path), "resnet18-cifar", save_period=1)
+    mgr.save_best(state.replace(step=np.asarray(6)), epoch=0,
+                  best_score=50.0)
+    mgr.save_latest(state.replace(step=np.asarray(9)), epoch=1,
+                    best_score=50.0, step_in_epoch=3)
+    mgr.wait()
+    # Uncapped: the newest track (the step-9 flush) wins.
+    out = mgr.restore_into(_state())
+    assert mgr.last_restore_rung == "latest"
+    assert int(out[0].step) == 9
+    # Capped at the fleet-agreed step 6: latest@9 is refused, best@6
+    # restores, and the trainer continues from epoch 1 step 0.
+    _resume_env.setenv(ENV_RESUME_STEP, "6")
+    restored, start_epoch, _ = mgr.restore_into(_state())
+    assert mgr.last_restore_rung == "best"
+    assert int(restored.step) == 6 and start_epoch == 1
+    # Cap below every committed rung (inconsistent supervisor input):
+    # restore the OLDEST rung — never the one furthest ahead.
+    _resume_env.setenv(ENV_RESUME_STEP, "3")
+    mgr.restore_into(_state())
+    assert mgr.last_restore_rung == "best"
+
+
+def test_gang_env_wiring_zero_syncs_zero_compiles(tmp_path, monkeypatch):
+    """PR-5 discipline for the gang path: the per-rank heartbeat file,
+    the fleet rank tag, and the resume-step env are pure host-side
+    plumbing — wiring them adds zero device transfers and zero compiles
+    (the checkers the chaos/gang soaks rely on)."""
+    from tpuic import telemetry
+    from tpuic.analysis import runtime as contracts
+    from tpuic.config import RunConfig
+    from tpuic.telemetry.events import bus, publish
+
+    hb_path = rank_path(str(tmp_path / "heartbeat.json"), 1)
+    monkeypatch.setenv("TPUIC_HEARTBEAT_FILE", hb_path)
+    monkeypatch.setenv("TPUIC_HEARTBEAT_INTERVAL_S", "0.0")
+    monkeypatch.setenv("TPUIC_FLEET_RANK", "1")
+    monkeypatch.setenv("TPUIC_FLEET_RANKS", "2")
+    monkeypatch.setenv(ENV_RESUME_STEP, "6")
+    tm = telemetry.TrainTelemetry(RunConfig())
+    try:
+        assert tm.heartbeat is not None and tm.rank == 1
+        with contracts.watch_compiles() as cw, \
+                contracts.count_device_gets() as gets:
+            for s in range(1, 4):
+                publish("step", step=s, total_ms=1.0)
+        assert gets.count == 0 and cw.compiles == 0
+        assert read_heartbeat(hb_path)["step"] == 3
+        assert bus.rank_tag == {"rank": 1, "ranks": 2}
+    finally:
+        tm.close()
+        bus.rank_tag = None
+
+
+# -- satellite: rank-targeted fault points -----------------------------------
+def test_rank_fault_points_registered_and_parse():
+    from tpuic.runtime.faults import REGISTERED_POINTS, FaultPlan
+    assert {"rank_crash", "rank_hang"} <= REGISTERED_POINTS
+    plan = FaultPlan("rank_crash@8#1")
+    assert plan.fire("rank_crash", step=8)
+    assert plan.param("rank_crash") == 1.0
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan("rank_cresh@8#1")
+
+
+# -- satellite: env rendezvous in runtime/distributed.py ---------------------
+@pytest.fixture
+def _rendezvous(monkeypatch):
+    """Isolate initialize(): no real jax.distributed call, no leaked
+    TPUIC_* env, fresh idempotency latch."""
+    import jax
+    from tpuic.runtime import distributed
+
+    calls = []
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address=None, num_processes=None,
+        process_id=None: calls.append(
+            (coordinator_address, num_processes, process_id)))
+    for var in ("TPUIC_COORDINATOR_ADDRESS", "TPUIC_NUM_PROCESSES",
+                "TPUIC_PROCESS_ID", "TPU_WORKER_HOSTNAMES",
+                "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch, calls
+
+
+def test_env_rendezvous_trio_feeds_initialize(_rendezvous):
+    from tpuic.runtime.distributed import initialize
+    monkeypatch, calls = _rendezvous
+    monkeypatch.setenv("TPUIC_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setenv("TPUIC_NUM_PROCESSES", "2")
+    monkeypatch.setenv("TPUIC_PROCESS_ID", "1")
+    initialize()
+    assert calls == [("10.0.0.1:8476", 2, 1)]
+
+
+def test_env_rendezvous_explicit_args_win(_rendezvous):
+    from tpuic.runtime.distributed import initialize
+    monkeypatch, calls = _rendezvous
+    monkeypatch.setenv("TPUIC_COORDINATOR_ADDRESS", "env:1")
+    monkeypatch.setenv("TPUIC_NUM_PROCESSES", "8")
+    monkeypatch.setenv("TPUIC_PROCESS_ID", "7")
+    initialize(coordinator_address="args:2", num_processes=4, process_id=3)
+    assert calls == [("args:2", 4, 3)]
+
+
+def test_env_rendezvous_half_set_fails_loud(_rendezvous):
+    """Mirrors tag_bus_with_rank: half a fleet identity is not an
+    identity — a coordinator or process id without the full trio must
+    raise, not silently fall back to auto-detection."""
+    from tpuic.runtime.distributed import initialize
+    monkeypatch, calls = _rendezvous
+    monkeypatch.setenv("TPUIC_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    with pytest.raises(ValueError, match="half-set"):
+        initialize()
+    monkeypatch.delenv("TPUIC_COORDINATOR_ADDRESS")
+    monkeypatch.setenv("TPUIC_PROCESS_ID", "1")
+    with pytest.raises(ValueError, match="half-set"):
+        initialize()
+    assert calls == []
+    # Explicit args can complete a partial env: not half-set anymore.
+    monkeypatch.setenv("TPUIC_NUM_PROCESSES", "2")
+    initialize(coordinator_address="args:9")
+    assert calls == [("args:9", 2, 1)]
+
+
+def test_env_rendezvous_num_processes_alone_keeps_autodiscovery(
+        _rendezvous):
+    """TPUIC_NUM_PROCESSES alone is the documented auto-discovery
+    trigger (docs/parallelism.md) — still valid, no error."""
+    from tpuic.runtime.distributed import initialize
+    monkeypatch, calls = _rendezvous
+    monkeypatch.setenv("TPUIC_NUM_PROCESSES", "2")
+    initialize()
+    assert calls == [(None, 2, None)]

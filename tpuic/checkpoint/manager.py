@@ -427,6 +427,60 @@ class CheckpointManager:
             int(meta.get(k, -1)) for k in GEOMETRY_META_KEYS)
         return epoch, best, sie
 
+    def _manifest_step(self, rung: str) -> Optional[int]:
+        """The committed optimizer step recorded in a rung's manifest
+        sidecar; None when the rung predates the manifest (or the
+        sidecar is unreadable) — those rungs carry no fleet-comparable
+        step."""
+        try:
+            with open(os.path.join(self.root,
+                                   rung + ".manifest.json")) as f:
+                step = json.load(f).get("step")
+            return int(step) if step is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def _apply_resume_cap(self, rungs):
+        """Fleet-consistent resume (runtime/gang.py): when the gang
+        supervisor passed ``TPUIC_RESUME_STEP`` — the newest step every
+        rank's committed manifest agrees on — rungs ahead of it are
+        refused, and the kept rungs are reordered newest-first below the
+        cap, so this rank lands exactly on the fleet-agreed step instead
+        of resuming ahead of peers that never committed it (a survivor's
+        mid-teardown flush is deliberately newer than a crashed peer's
+        last commit — the precise rung this filter exists to skip)."""
+        from tpuic.runtime.supervisor import ENV_RESUME_STEP
+        raw = os.environ.get(ENV_RESUME_STEP, "")
+        if not raw or not rungs:
+            return rungs
+        allowed = int(raw)  # a malformed supervisor env must fail LOUD
+        steps = {r: self._manifest_step(r) for r in rungs}
+        kept = [r for r in rungs
+                if steps[r] is None or steps[r] <= allowed]
+        skipped = [r for r in rungs if r not in kept]
+        if not kept:
+            # Inconsistent with the supervisor's agreed-step math (it
+            # only names steps at least one of this rank's rungs holds);
+            # restore the OLDEST rung — closest to the fleet, never the
+            # one furthest ahead — and say so.
+            host0_print(
+                f"[ckpt] fleet resume: EVERY rung is ahead of the "
+                f"fleet-agreed step {allowed} "
+                f"({ {r: steps[r] for r in rungs} }) — restoring the "
+                "oldest available rung instead")
+            return sorted(rungs, key=lambda r: (steps[r] is None,
+                                                steps[r] or 0))
+        if skipped:
+            host0_print(
+                f"[ckpt] fleet resume: skipping rung(s) ahead of the "
+                f"fleet-agreed step {allowed}: "
+                + ", ".join(f"{r}@{steps[r]}" for r in skipped))
+        # Newest rung at-or-below the cap first; manifest-less rungs
+        # (pre-ladder, step unknown) keep their ladder order at the end.
+        known = [r for r in kept if steps[r] is not None]
+        unknown = [r for r in kept if steps[r] is None]
+        return sorted(known, key=lambda r: -steps[r]) + unknown
+
     def verify_track(self, track: str) -> Tuple[bool, str]:
         """Check a track's on-disk bytes against its commit manifest.
 
@@ -502,6 +556,7 @@ class CheckpointManager:
             rungs = [track, track + ".prev"]
         rungs = [t for t in rungs
                  if os.path.isdir(os.path.join(self.root, t))]
+        rungs = self._apply_resume_cap(rungs)
         if not rungs:
             return state, 0, 0.0
         failures = []

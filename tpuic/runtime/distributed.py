@@ -66,19 +66,41 @@ def initialize(coordinator_address: str | None = None,
     JAX auto-discover the topology. Plain single-process runs skip it.
 
     Launchers without a cluster runtime (CPU fleets, the CI fleet smoke
-    — scripts/fleet_smoke.py) pass the rendezvous through the
-    environment instead of code: TPUIC_COORDINATOR_ADDRESS +
-    TPUIC_NUM_PROCESSES + TPUIC_PROCESS_ID fill any argument the caller
-    left None, so ``python train.py`` joins a fleet without new flags.
+    — scripts/fleet_smoke.py; the gang supervisor's ``--coordinator``
+    path, runtime/gang.py) pass the rendezvous through the environment
+    instead of code: TPUIC_COORDINATOR_ADDRESS + TPUIC_NUM_PROCESSES +
+    TPUIC_PROCESS_ID fill any argument the caller left None, so
+    ``python train.py`` joins a fleet without new flags. Explicit
+    arguments always win over the env. A HALF-set env rendezvous
+    (coordinator or process id without the full trio resolvable) raises
+    instead of silently falling back to auto-detection — the same loud
+    failure as telemetry/fleet.py's ``tag_bus_with_rank``: half a fleet
+    identity is not an identity, and k workers silently collapsing to
+    auto-discovered rank 0/1 would wedge the rendezvous (or worse,
+    train as the wrong fleet) with nothing in the logs.
     """
     global _initialized
+    env_addr = os.environ.get("TPUIC_COORDINATOR_ADDRESS") or None
+    env_num = os.environ.get("TPUIC_NUM_PROCESSES") or None
+    env_pid = os.environ.get("TPUIC_PROCESS_ID") or None
     if coordinator_address is None:
-        coordinator_address = (
-            os.environ.get("TPUIC_COORDINATOR_ADDRESS") or None)
-    if num_processes is None and os.environ.get("TPUIC_NUM_PROCESSES"):
-        num_processes = int(os.environ["TPUIC_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("TPUIC_PROCESS_ID"):
-        process_id = int(os.environ["TPUIC_PROCESS_ID"])
+        coordinator_address = env_addr
+    if num_processes is None and env_num:
+        num_processes = int(env_num)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+    if (env_addr is not None or env_pid is not None) and (
+            coordinator_address is None or num_processes is None
+            or process_id is None):
+        # TPUIC_NUM_PROCESSES alone stays valid (the documented
+        # auto-discovery trigger); naming a coordinator or a process id
+        # commits the launcher to the full trio.
+        raise ValueError(
+            f"TPUIC env rendezvous is half-set: TPUIC_COORDINATOR_ADDRESS="
+            f"{env_addr!r}, TPUIC_NUM_PROCESSES={env_num!r}, "
+            f"TPUIC_PROCESS_ID={env_pid!r} — a launcher must set all "
+            "three (or none; TPUIC_NUM_PROCESSES alone keeps the "
+            "auto-discovery path)")
     multi = (coordinator_address is not None
              or num_processes not in (None, 1)
              or _looks_multi_host())

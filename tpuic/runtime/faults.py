@@ -48,6 +48,20 @@ Registered points (site → meaning of ``step``):
                       ``TPUIC_FAULTS='flood#200'`` drives the engine
                       past its knee with traffic the brownout/priority
                       machinery is supposed to shed.
+- ``rank_crash``    — train loop: SIGKILL this process at the given
+                      global step, but ONLY on the rank ``param`` names
+                      (default 0; rank identity from the telemetry fleet
+                      tag — TPUIC_FLEET_RANK or runtime_info). The
+                      partial-failure trigger for the gang supervisor
+                      (runtime/gang.py): ``rank_crash@8#1`` kills rank 1
+                      at step 8 while every other rank keeps running —
+                      exactly the one-dead-rank-wedges-the-fleet shape
+                      the coordinated teardown exists for.
+- ``rank_hang``     — train loop: wedge FOREVER at the given global
+                      step, only on rank ``param`` (default 0) — the
+                      partial-hang twin of ``rank_crash`` for the gang's
+                      per-rank watchdog (rank-attributed SIGQUIT
+                      escalation, then coordinated teardown).
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
@@ -88,7 +102,8 @@ __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
 # read as "the system survived the fault" when no fault happened).
 REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
-    "slow_step", "hard_crash", "hang_step", "flood",
+    "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
+    "rank_hang",
 })
 
 

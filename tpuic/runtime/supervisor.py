@@ -91,6 +91,11 @@ ENV_STACK_DUMP = "TPUIC_STACK_DUMP"
 ENV_FLIGHT_DUMP = "TPUIC_FLIGHT_DUMP"  # telemetry/flight.py reads it
 ENV_RESTART = "TPUIC_RESTART"
 ENV_DOWN_SINCE = "TPUIC_DOWN_SINCE"
+# Fleet-consistent resume cap (runtime/gang.py): on a gang restart the
+# supervisor computes the newest checkpoint step every rank's committed
+# manifest agrees on and passes it here; CheckpointManager.restore_into
+# then refuses rungs ahead of it, so no rank resumes past the fleet.
+ENV_RESUME_STEP = "TPUIC_RESUME_STEP"
 
 
 class NonRetryableError(RuntimeError):
@@ -327,6 +332,153 @@ class AttemptResult:
     duration_s: float
 
 
+class _Child:
+    """One supervised OS process: the spawn-time artifact environment
+    (heartbeat file, per-attempt stack/flight dump paths), heartbeat
+    observation, and the escalation ladder (SIGQUIT stack+flight dump →
+    SIGTERM flush window → SIGKILL).
+
+    Shared by the single-child :class:`Supervisor` below and the gang
+    supervisor (``runtime/gang.py``), so the escalation semantics — and
+    their hard-won flake fixes, above all *one SIGTERM per pid* (a
+    second TERM can land inside the child's flush ``sys.exit(43)`` after
+    interpreter finalization restored the default handler and kill it
+    -15 mid-exit) — exist exactly once instead of as a copy per
+    supervisor flavor."""
+
+    def __init__(self, cmd: Sequence[str], *, heartbeat_file: str,
+                 stack_dump: str, flight_dump: str, label: str = "") -> None:
+        self.cmd = list(cmd)
+        self.heartbeat_file = heartbeat_file
+        self.stack_dump = stack_dump
+        self.flight_dump = flight_dump
+        self.label = label  # "" for the single child; "rank k" in a gang
+        self.proc: Optional[subprocess.Popen] = None
+        self._term_pid: Optional[int] = None  # pid already SIGTERMed
+        self.hung = False
+        self.first_step: Optional[int] = None
+        self.last_step: Optional[int] = None
+        self.last_beats = -1
+        self.spawned_at = 0.0
+        self.last_change = 0.0
+
+    def spawn(self, env: Dict[str, str]) -> subprocess.Popen:
+        """Start the process with the artifact env injected. Heartbeat
+        freshness is per-attempt: any stale file is removed first."""
+        try:
+            os.remove(self.heartbeat_file)
+        except OSError:
+            pass
+        env = dict(env)
+        env[ENV_HEARTBEAT_FILE] = self.heartbeat_file
+        env[ENV_STACK_DUMP] = self.stack_dump
+        env[ENV_FLIGHT_DUMP] = self.flight_dump
+        self.hung = False
+        self.first_step = self.last_step = None
+        self.last_beats = -1
+        self.spawned_at = self.last_change = time.monotonic()
+        self.proc = subprocess.Popen(self.cmd, env=env)
+        return self.proc
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll() if self.proc is not None else None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def signal(self, sig: int) -> None:
+        if self.alive():
+            try:
+                self.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def term(self) -> bool:
+        """SIGTERM (the PR-2 flush path), at most once per pid — callable
+        from both the supervisor's signal handler and its poll loop
+        without risking the double-TERM flake documented above. Returns
+        whether a TERM was actually sent."""
+        if self.proc is None or self._term_pid == self.proc.pid:
+            return False
+        self.signal(signal.SIGTERM)
+        self._term_pid = self.proc.pid
+        return True
+
+    def observe(self, now: Optional[float] = None) -> None:
+        """Fold the heartbeat file into the liveness view: beat-count
+        changes move ``last_change``; the payload's exact ``first_step``
+        wins over whichever step a throttled write + poll happened to
+        sample first (the accounting check compares true first steps)."""
+        now = time.monotonic() if now is None else now
+        hb = read_heartbeat(self.heartbeat_file)
+        if hb is None:
+            return
+        beats = int(hb.get("beats", 0))
+        if beats != self.last_beats:
+            self.last_beats = beats
+            self.last_change = now
+        fs = hb.get("first_step")
+        if fs is not None:
+            self.first_step = int(fs)
+        step = hb.get("step")
+        if step is not None:
+            step = int(step)
+            if self.first_step is None:
+                self.first_step = step
+            self.last_step = step
+
+    def stale_s(self, now: Optional[float] = None) -> float:
+        return (time.monotonic() if now is None else now) - self.last_change
+
+    def window_s(self, watchdog_s: float, startup_grace_s: float) -> float:
+        """The liveness window currently in force: startup grace before
+        the first observed beat, the watchdog after."""
+        return watchdog_s if self.last_beats >= 0 else startup_grace_s
+
+    def escalate(self, quit_wait_s: float, grace_s: float) -> None:
+        """The hang ladder: SIGQUIT (faulthandler stacks + flight-recorder
+        timeline land in the per-attempt artifacts), a pause for the
+        dumps, then SIGTERM with the flush grace window, then SIGKILL."""
+        self.hung = True
+        if hasattr(signal, "SIGQUIT"):
+            self.signal(signal.SIGQUIT)
+            try:  # let faulthandler finish writing the dump
+                self.proc.wait(timeout=quit_wait_s)
+            except subprocess.TimeoutExpired:
+                pass
+        self.term()
+        self.wait_or_kill(grace_s)
+
+    def wait_or_kill(self, grace_s: float) -> bool:
+        """Wait up to ``grace_s`` for exit; SIGKILL on timeout. Returns
+        whether the kill was needed."""
+        try:
+            self.proc.wait(timeout=grace_s)
+            return False
+        except subprocess.TimeoutExpired:
+            self.signal(signal.SIGKILL)
+            self.proc.wait()
+            return True
+
+    def finalize(self) -> int:
+        """Reap the process and fold the FINAL heartbeat state (the last
+        write may have landed after the last poll). Returns the exit
+        code."""
+        rc = self.proc.wait()
+        hb = read_heartbeat(self.heartbeat_file)
+        if hb is not None and hb.get("step") is not None:
+            self.last_step = int(hb["step"])
+            if hb.get("first_step") is not None:
+                self.first_step = int(hb["first_step"])
+            if self.first_step is None:
+                self.first_step = self.last_step
+        return rc
+
+
 class Supervisor:
     """Run ``cmd`` as a supervised child; see the module docstring for
     the protocol. ``state_dir`` holds the heartbeat file, the progress
@@ -367,9 +519,8 @@ class Supervisor:
         self.extra_env = dict(env or {})
         self._log = log or (lambda msg: print(f"[supervise] {msg}",
                                               file=sys.stderr, flush=True))
-        self._child: Optional[subprocess.Popen] = None
+        self._child: Optional[_Child] = None
         self._shutdown = False
-        self._term_pid: Optional[int] = None  # child pid already SIGTERMed
         self.restarts = 0        # total (incl. clean preemption flushes)
         self.crash_restarts = 0  # retryable failures only — the budget
         self.attempts: List[AttemptResult] = []
@@ -392,40 +543,21 @@ class Supervisor:
     def _on_signal(self, signum, frame) -> None:
         self._shutdown = True
         child = self._child
-        # One SIGTERM per child, here too: a repeated external SIGTERM
-        # (impatient orchestrator) must not deliver a second TERM that
-        # can land inside the child's flush sys.exit(43) after
-        # finalization restored the default handler (see the shutdown
-        # branch in _run_attempt).
-        if (child is not None and child.poll() is None
-                and self._term_pid != child.pid):
-            try:
-                child.send_signal(signal.SIGTERM)  # the PR-2 flush path
-                self._term_pid = child.pid
-            except OSError:
-                pass
-
-    def _signal(self, sig: int) -> None:
-        child = self._child
-        if child is not None and child.poll() is None:
-            try:
-                child.send_signal(sig)
-            except OSError:
-                pass
+        # One SIGTERM per child, here too (the _Child.term() guard): a
+        # repeated external SIGTERM (impatient orchestrator) must not
+        # deliver a second TERM that can land inside the child's flush
+        # sys.exit(43) after finalization restored the default handler
+        # (see the shutdown branch in _run_attempt).
+        if child is not None:
+            child.term()  # the PR-2 flush path
 
     # -- one attempt ----------------------------------------------------
     def _spawn_env(self, attempt: int, down_since: float) -> Dict[str, str]:
+        # Artifact paths (heartbeat file, per-attempt stack/flight dump)
+        # are injected by _Child.spawn; this builds everything else.
         env = dict(os.environ)
         env.update(self.extra_env)
-        env[ENV_HEARTBEAT_FILE] = self.heartbeat_file
         env[ENV_HEARTBEAT_INTERVAL] = repr(self.heartbeat_interval_s)
-        env[ENV_STACK_DUMP] = os.path.join(self.state_dir,
-                                           f"stackdump-{attempt}.txt")
-        # Flight recorder (telemetry/flight.py): the child dumps its
-        # last-N-events ring here on SIGQUIT — the hang escalation now
-        # yields stacks AND the event timeline leading into the wedge.
-        env[ENV_FLIGHT_DUMP] = os.path.join(self.state_dir,
-                                            f"flightdump-{attempt}.jsonl")
         env[ENV_RESTART] = str(attempt)
         env[ENV_DOWN_SINCE] = repr(down_since)
         if self.chaos:
@@ -434,111 +566,63 @@ class Supervisor:
         return env
 
     def _run_attempt(self, attempt: int, down_since: float) -> AttemptResult:
-        try:
-            os.remove(self.heartbeat_file)  # freshness is per-attempt
-        except OSError:
-            pass
         env = self._spawn_env(attempt, down_since)
+        child = _Child(
+            self.cmd, heartbeat_file=self.heartbeat_file,
+            stack_dump=os.path.join(self.state_dir,
+                                    f"stackdump-{attempt}.txt"),
+            # Flight recorder (telemetry/flight.py): the child dumps its
+            # last-N-events ring here on SIGQUIT — the hang escalation
+            # yields stacks AND the event timeline leading into the wedge.
+            flight_dump=os.path.join(self.state_dir,
+                                     f"flightdump-{attempt}.jsonl"))
+        self._child = child
         t0 = time.monotonic()
-        self._child = subprocess.Popen(self.cmd, env=env)
-        self._ledger("spawn", attempt=attempt, pid=self._child.pid,
+        child.spawn(env)
+        self._ledger("spawn", attempt=attempt, pid=child.pid,
                      restart=attempt > 0,
                      faults=env.get("TPUIC_FAULTS", "") if self.chaos else "")
-        first_step: Optional[int] = None
-        last_step: Optional[int] = None
-        last_beats = -1
-        last_change = t0
-        hung = False
-        while self._child.poll() is None:
+        while child.poll() is None:
             time.sleep(self.poll_s)
             now = time.monotonic()
-            hb = read_heartbeat(self.heartbeat_file)
-            if hb is not None:
-                step = hb.get("step")
-                beats = int(hb.get("beats", 0))
-                if beats != last_beats:
-                    last_beats = beats
-                    last_change = now
-                # Prefer the writer-recorded exact first step: the file
-                # is write-throttled and we only poll it, so the first
-                # SAMPLED step of a fast run can be dozens of steps past
-                # the true first — a spurious accounting "violation".
-                fs = hb.get("first_step")
-                if fs is not None:
-                    first_step = int(fs)
-                if step is not None:
-                    step = int(step)
-                    if first_step is None:
-                        first_step = step
-                    last_step = step
+            child.observe(now)
             if self._shutdown:
                 # Usually the handler already forwarded SIGTERM — but a
                 # child spawned AFTER the flag was set (signal landed
                 # between attempts, when _child was None) never got it;
-                # send it here, give the child the full grace window to
-                # flush, then make sure it dies. Only to a child that
-                # never got the forward: a SECOND SIGTERM is NOT
-                # harmless — it can land while the child is already
-                # inside its flush's sys.exit(43), where interpreter
-                # finalization has restored the default handler, and
-                # kill it -15 mid-exit (a ~1-in-12 flake in the shared-
-                # eviction test, caught live in PR 8).
-                if self._term_pid != self._child.pid:
-                    self._signal(signal.SIGTERM)
-                    self._term_pid = self._child.pid
-                try:
-                    self._child.wait(timeout=self.grace_s)
-                except subprocess.TimeoutExpired:
-                    self._log(f"attempt {attempt}: no exit {self.grace_s:.0f}s "
-                              "after forwarded SIGTERM; killing")
-                    self._signal(signal.SIGKILL)
-                    self._child.wait()
+                # term() here is a no-op in the forwarded case (one TERM
+                # per pid — a SECOND SIGTERM can land inside the child's
+                # flush sys.exit(43) after interpreter finalization
+                # restored the default handler and kill it -15 mid-exit,
+                # a ~1-in-12 flake caught live in PR 8). Then the full
+                # grace window to flush, then make sure it dies.
+                child.term()
+                if child.wait_or_kill(self.grace_s):
+                    self._log(f"attempt {attempt}: no exit "
+                              f"{self.grace_s:.0f}s after forwarded "
+                              "SIGTERM; killing")
                 break
-            window = (self.watchdog_s if last_beats >= 0
-                      else self.startup_grace_s)
-            if now - last_change > window:
-                hung = True
-                stale = now - last_change
+            window = child.window_s(self.watchdog_s, self.startup_grace_s)
+            if child.stale_s(now) > window:
+                stale = child.stale_s(now)
                 self._log(f"attempt {attempt}: HANG — no heartbeat for "
                           f"{stale:.1f}s (window {window:.0f}s, last step "
-                          f"{last_step}); SIGQUIT for a stack dump, then "
-                          f"SIGTERM, then SIGKILL")
+                          f"{child.last_step}); SIGQUIT for a stack dump, "
+                          f"then SIGTERM, then SIGKILL")
                 self._ledger("hang", attempt=attempt, stale_s=round(stale, 1),
-                             last_step=last_step,
-                             stack_dump=env[ENV_STACK_DUMP],
-                             flight_dump=env[ENV_FLIGHT_DUMP])
-                if hasattr(signal, "SIGQUIT"):
-                    self._signal(signal.SIGQUIT)
-                    try:  # let faulthandler finish writing the dump
-                        self._child.wait(timeout=self.quit_wait_s)
-                    except subprocess.TimeoutExpired:
-                        pass
-                self._signal(signal.SIGTERM)
-                # Record it (like every other TERM-send site): a
-                # concurrent external SIGTERM's handler must not
-                # deliver a SECOND TERM into the child's flush
-                # finalization window.
-                self._term_pid = self._child.pid
-                try:
-                    self._child.wait(timeout=self.grace_s)
-                except subprocess.TimeoutExpired:
-                    self._signal(signal.SIGKILL)
-                    self._child.wait()
+                             last_step=child.last_step,
+                             stack_dump=child.stack_dump,
+                             flight_dump=child.flight_dump)
+                child.escalate(self.quit_wait_s, self.grace_s)
                 break
-        rc = self._child.wait()
-        hb = read_heartbeat(self.heartbeat_file)
-        if hb is not None and hb.get("step") is not None:
-            last_step = int(hb["step"])
-            if hb.get("first_step") is not None:
-                first_step = int(hb["first_step"])
-            if first_step is None:
-                first_step = last_step
-        res = AttemptResult(attempt=attempt, returncode=rc, hung=hung,
-                            first_step=first_step, last_step=last_step,
+        rc = child.finalize()
+        res = AttemptResult(attempt=attempt, returncode=rc, hung=child.hung,
+                            first_step=child.first_step,
+                            last_step=child.last_step,
                             duration_s=round(time.monotonic() - t0, 3))
         self._child = None
-        self._ledger("exit", attempt=attempt, returncode=rc, hung=hung,
-                     first_step=first_step, last_step=last_step,
+        self._ledger("exit", attempt=attempt, returncode=rc, hung=child.hung,
+                     first_step=child.first_step, last_step=child.last_step,
                      duration_s=res.duration_s,
                      outcome=classify_exit(rc, self._shutdown))
         return res

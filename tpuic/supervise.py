@@ -17,9 +17,23 @@ Liveness, hang escalation (SIGQUIT stack dump -> SIGTERM -> SIGKILL),
 the exit-code contract, and the crash-loop policy live in
 tpuic/runtime/supervisor.py.
 
-``--chaos`` (used by scripts/chaos_soak.py) assigns a per-attempt
-``TPUIC_FAULTS`` spec, semicolon-separated: attempt 0 gets the first
-spec, attempt 1 the second, …; attempts past the list run fault-free.
+``--chaos`` (used by scripts/chaos_soak.py and scripts/gang_soak.py)
+assigns a per-attempt ``TPUIC_FAULTS`` spec, semicolon-separated:
+attempt 0 gets the first spec, attempt 1 the second, …; attempts past
+the list run fault-free.
+
+``--gang N`` supervises N ranks as ONE unit (runtime/gang.py): per-rank
+heartbeat watchdogs with rank-attributed hang escalation, coordinated
+teardown + restart on any partial failure (survivors get the SIGTERM
+flush window, then all ranks restart together), poison from any rank
+stopping the gang, and — with ``--gang-ckpt`` — a fleet-agreed resume
+step passed down via ``TPUIC_RESUME_STEP`` so no rank resumes ahead of
+the fleet. ``{rank}`` in the child command is substituted per rank::
+
+    python -m tpuic.supervise --gang 4 \\
+        --gang-ckpt /work/cp{rank}/resnet50 -- \\
+        python train.py --datadir /data --model resnet50 \\
+            --ckpt-dir /work/cp{rank}
 """
 
 from __future__ import annotations
@@ -73,6 +87,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", default="",
                    help="per-attempt TPUIC_FAULTS specs, ';'-separated "
                         "(fault-injection soaks; see scripts/chaos_soak.py)")
+    p.add_argument("--gang", type=int, default=0, metavar="N",
+                   help="supervise N ranks as one unit (runtime/gang.py): "
+                        "coordinated teardown + restart on partial failure, "
+                        "per-rank watchdogs, fleet-agreed resume. '{rank}' "
+                        "in the child command is substituted per rank")
+    p.add_argument("--gang-ckpt", default="", metavar="DIR",
+                   help="per-rank checkpoint MODEL dir template ('{rank}' "
+                        "substituted), e.g. '/work/cp{rank}/resnet50' — "
+                        "the dirs holding the *.manifest.json sidecars. "
+                        "Enables restart-consistent resume: the newest "
+                        "step every rank's committed manifest agrees on "
+                        "is passed down via TPUIC_RESUME_STEP")
+    p.add_argument("--coordinator", default="", metavar="HOST:PORT",
+                   help="TPUIC_COORDINATOR_ADDRESS for the ranks (also "
+                        "sets TPUIC_PROCESS_ID/TPUIC_NUM_PROCESSES — the "
+                        "jax.distributed env rendezvous, runtime/"
+                        "distributed.py) for fleets with real "
+                        "collectives; omit for independent-rank fleets "
+                        "(the CPU CI soak)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- followed by the child command")
     return p
@@ -88,17 +121,22 @@ def main(argv=None) -> int:
         print("supervise: no child command (everything after '--' is the "
               "command to supervise)", file=sys.stderr)
         return 2
-    sup = Supervisor(
-        cmd, args.state_dir,
+    chaos = ([s.strip() for s in args.chaos.split(";")] if args.chaos
+             else None)
+    common = dict(
         watchdog_s=args.watchdog_s, startup_grace_s=args.startup_grace_s,
         quit_wait_s=args.quit_wait_s, grace_s=args.grace_s,
         poll_s=args.poll_s, max_restarts=args.max_restarts,
         backoff_s=args.backoff_s, backoff_max_s=args.backoff_max_s,
         crash_loop_k=args.crash_loop_k,
-        heartbeat_interval_s=args.heartbeat_interval_s,
-        chaos=[s.strip() for s in args.chaos.split(";")] if args.chaos
-        else None)
-    return sup.run()
+        heartbeat_interval_s=args.heartbeat_interval_s, chaos=chaos)
+    if args.gang:
+        from tpuic.runtime.gang import GangSupervisor
+        return GangSupervisor(
+            cmd, args.state_dir, ranks=args.gang,
+            ckpt_dirs=args.gang_ckpt or None,
+            coordinator=args.coordinator, **common).run()
+    return Supervisor(cmd, args.state_dir, **common).run()
 
 
 if __name__ == "__main__":

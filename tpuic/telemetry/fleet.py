@@ -290,12 +290,30 @@ def main(argv=None) -> int:
     p.add_argument("--expect-straggler", type=int, default=None,
                    help="exit 1 unless the straggler verdict names this "
                         "rank (the CI fleet smoke's assertion)")
+    p.add_argument("--require-ranks", type=int, default=0, metavar="N",
+                   help="exit 1 unless the streams cover exactly ranks "
+                        "0..N-1 — a fleet run missing a rank's stream "
+                        "entirely (dead rank, wrong path) must fail "
+                        "loudly, not have its skew silently computed "
+                        "over whichever ranks showed up (the gang soak "
+                        "and multi-host runs pass their fleet size here)")
     args = p.parse_args(argv)
 
     streams = load_streams(args.paths)
     if not streams:
         print("[fleet] no event streams found", file=sys.stderr)
         return 2
+    if args.require_ranks:
+        expected = set(range(args.require_ranks))
+        missing = sorted(expected - set(streams))
+        extra = sorted(set(streams) - expected)
+        if missing or extra:
+            print(f"[fleet] FAIL: --require-ranks {args.require_ranks}: "
+                  + (f"missing rank stream(s) {missing}" if missing else "")
+                  + (" and " if missing and extra else "")
+                  + (f"unexpected rank(s) {extra}" if extra else "")
+                  + f" (found ranks {sorted(streams)})", file=sys.stderr)
+            return 1
     report = aggregate(streams, warmup=max(0, args.warmup))
     for line in summary_lines(report):
         print(line)

@@ -381,6 +381,24 @@ class Trainer:
                             else time.monotonic() + float(hang_s))  # tpuic-ok: TPU101 fault param is a host float
                 while deadline is None or time.monotonic() < deadline:
                     time.sleep(0.5)
+            if _faults.fire("rank_crash", step=step0 + step):
+                # Rank-targeted SIGKILL (#PARAM names the victim rank,
+                # default 0): one member of a gang dies abruptly while
+                # its peers keep running — the partial failure the gang
+                # supervisor (runtime/gang.py) must answer with a
+                # coordinated teardown + restart. Every rank evaluates
+                # the armed directive; only the named one dies.
+                target = _faults.param("rank_crash")
+                if int(self.telemetry.rank) == int(target or 0):
+                    os.kill(os.getpid(), signal.SIGKILL)
+            if _faults.fire("rank_hang", step=step0 + step):
+                # Rank-targeted wedge (forever; #PARAM names the rank,
+                # default 0): the partial-hang twin — only the gang's
+                # per-rank watchdog escalation ends it.
+                target = _faults.param("rank_hang")
+                if int(self.telemetry.rank) == int(target or 0):
+                    while True:
+                        time.sleep(0.5)
             steptime.dispatch_start()
             self.state, metrics = self.train_step(self.state, fbatch)
             steptime.dispatch_end()
