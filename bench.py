@@ -98,9 +98,22 @@ def _measure(platform: str) -> dict:
 
     # FLOPs per step from the compiled executable.
     t_comp = time.perf_counter()
+    flops_drift = None
     try:
-        flops_per_step = float(
-            step.lower(state, batch).compile().cost_analysis()["flops"])
+        from tpuic.telemetry.goodput import (check_flops_drift,
+                                             cost_analysis_dict)
+        flops_per_step = float(cost_analysis_dict(
+            step.lower(state, batch).compile())["flops"])
+        # Ride-along cross-check (docs/observability.md): the analytic
+        # table the in-band MFU accounting uses vs the compiler's count
+        # this headline uses — a >10% drift warns loudly (stderr; the
+        # stdout JSON contract is untouched) instead of letting the two
+        # MFU sources silently diverge.  Per-CHIP batch: under SPMD the
+        # compiled cost analysis describes one device's program shard.
+        flops_drift = check_flops_drift(
+            "resnet50", size, per_chip_batch, flops_per_step,
+            warn=lambda msg: print(f"[bench] WARNING: {msg}",
+                                   file=sys.stderr))
     except Exception:
         # Analytic fwd+bwd estimate — the telemetry subsystem's formula
         # (numerically identical to the old inline 3*2*4.1e9*B/2).
@@ -237,6 +250,8 @@ def _measure(platform: str) -> dict:
             "device": getattr(jax.devices()[0], "device_kind", "unknown"),
             "platform": jax.devices()[0].platform,
             "flops_per_step": flops_per_step,
+            "analytic_flops_drift": (round(flops_drift, 4)
+                                     if flops_drift is not None else None),
             "step_time_ms": round(1000 * dt / n_steps, 2),
             "step_latency_ms": step_latency,
             "trial_spread": spread,

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Profile smoke gate (ISSUE 11 acceptance; runs in tier-1 CI).
+
+Drives the REAL device-time-attribution wiring end to end, then proves
+the roofline gate is bidirectional:
+
+1. A short real ``train.py`` run with tracing forced (``TPUIC_TRACE``)
+   and ``--trace-analyze``: the trace trigger must capture a window, the
+   analyzer must auto-run (trace started/stopped events + at least one
+   ``profile`` event), and the final waterfall's per-op-class device
+   times must sum to within ``--tolerance`` of the measured telemetry
+   ``device_ms`` bucket, each class carrying a roofline verdict and the
+   per-layer rollup naming real model layers.
+2. ``python -m tpuic.telemetry.profile --check`` against the committed
+   ``perf/roofline_baseline.json`` must pass clean, and the same check
+   under a seeded partial stall (``--inject slow_step``) must FAIL
+   naming the shifted metric — a gate that cannot fire is decoration.
+
+The analysis JSONs land in --workdir (uploaded as CI artifacts on
+failure).  Exit 0 on success.
+
+    python scripts/profile_smoke.py [--steps 12] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VERDICTS = {"compute-bound", "hbm-bound", "overhead"}
+
+
+def fail(msg: str) -> int:
+    print(f"[profile-smoke] FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="max |sum(class ms) - device bucket| / bucket")
+    p.add_argument("--workdir", default="",
+                   help="where the analysis JSONs land (default: temp)")
+    p.add_argument("--keep", action="store_true")
+    args = p.parse_args()
+
+    work = args.workdir or tempfile.mkdtemp(prefix="tpuic_profile_smoke_")
+    os.makedirs(work, exist_ok=True)
+    try:
+        sys.path.insert(0, _REPO)
+        from tpuic.telemetry.events import read_jsonl
+        from tpuic.telemetry.profile import profile_workload
+
+        # -- 1. real wiring: train.py + TPUIC_TRACE + --trace-analyze --
+        run_dir = os.path.join(work, "run")
+        _, wf = profile_workload(args.steps, keep_dir=run_dir)
+        with open(os.path.join(work, "waterfall.json"), "w") as f:
+            json.dump(wf, f, indent=2)
+        recs = read_jsonl(os.path.join(run_dir, "events.jsonl"))
+        trace_actions = [r.get("action") for r in recs
+                         if r["event"] == "trace"]
+        if "started" not in trace_actions or "stopped" not in trace_actions:
+            return fail(f"forced trace window did not capture cleanly "
+                        f"(trace actions: {trace_actions})")
+        profiles = [r for r in recs if r["event"] == "profile"
+                    and not r.get("error")]
+        if not profiles:
+            return fail("no successful profile event published")
+        classes = wf.get("classes") or {}
+        if not classes:
+            return fail("final waterfall has no op classes")
+        total = sum(c["ms"] for c in classes.values())
+        bucket = float(wf.get("device_ms_per_step") or 0.0)
+        if bucket <= 0:
+            return fail(f"no measured device bucket in the waterfall: {wf}")
+        gap = abs(total - bucket) / bucket
+        if gap > args.tolerance:
+            return fail(
+                f"op-class times sum to {total:.3f} ms but the telemetry "
+                f"device bucket is {bucket:.3f} ms/step "
+                f"({100 * gap:.1f}% > {100 * args.tolerance:.0f}%)")
+        missing = [k for k, c in classes.items()
+                   if c.get("verdict") not in VERDICTS]
+        if missing:
+            return fail(f"classes without a roofline verdict: {missing}")
+        if not any("layer" in k for k in (wf.get("layers") or {})):
+            return fail(f"per-layer rollup names no model layers: "
+                        f"{list((wf.get('layers') or {}))[:5]}")
+        print(f"[profile-smoke] waterfall OK: {len(classes)} classes sum "
+              f"{total:.2f} ms vs device bucket {bucket:.2f} ms/step "
+              f"({100 * gap:.2f}%), "
+              f"{len(wf.get('layers') or {})} layers, "
+              f"{wf.get('tainted_steps_excluded', 0)} tainted steps "
+              f"excluded")
+
+        # -- 2. the roofline gate, both directions ---------------------
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TF_CPP_MIN_LOG_LEVEL="3")
+        base = [sys.executable, "-m", "tpuic.telemetry.profile",
+                "--check", "--steps", str(args.steps)]
+        clean = subprocess.run(
+            base + ["--report", os.path.join(work, "gate_clean.json")],
+            cwd=_REPO, env=env, text=True, capture_output=True,
+            timeout=1200)
+        if clean.returncode != 0:
+            return fail(f"clean roofline check failed "
+                        f"(rc={clean.returncode}):\n{clean.stdout[-1500:]}"
+                        f"\n{clean.stderr[-800:]}")
+        print("[profile-smoke] clean roofline check passed")
+        faulted = subprocess.run(
+            base + ["--inject", "slow_step", "--expect-fail",
+                    "--report", os.path.join(work, "gate_faulted.json")],
+            cwd=_REPO, env=env, text=True, capture_output=True,
+            timeout=1200)
+        if faulted.returncode != 0:
+            return fail(
+                f"seeded stall did NOT trip the roofline gate "
+                f"(rc={faulted.returncode}):\n{faulted.stdout[-1500:]}"
+                f"\n{faulted.stderr[-800:]}")
+        with open(os.path.join(work, "gate_faulted.json")) as f:
+            rep = json.load(f)
+        print(f"[profile-smoke] seeded stall tripped the gate on: "
+              f"{', '.join(rep.get('regressed_metrics', []))}")
+        print("[profile-smoke] OK")
+        return 0
+    finally:
+        if not args.keep and not args.workdir:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -323,6 +323,12 @@ class RunConfig:
     trace_threshold: float = 3.0
     trace_steps: int = 3
     trace_keep: int = 4
+    # Device-time attribution (telemetry/profile.py): auto-analyze every
+    # captured trace window (and the run's full step history at fit()
+    # end) into a per-op-class roofline waterfall published as 'profile'
+    # events.  Off by default: the analysis AOT-compiles the train step
+    # once for its HLO/cost-analysis view.
+    trace_analyze: bool = False
     # Step-time SLOs (telemetry/slo.py): comma list of objective specs,
     # e.g. 'train_step:p99<=500ms@0.99'. Rolling attainment and
     # error-budget burn rate ride the goodput log line, the 'slo' bus

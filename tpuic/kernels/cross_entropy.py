@@ -189,10 +189,12 @@ def fused_weighted_cross_entropy(logits, labels,
     if interpret is None:
         from tpuic.kernels import default_interpret
         interpret = default_interpret()
-    cw, m = _canonicalize(logits, labels, class_weights, mask)
-    wnll, w = _persample(logits, labels, cw, m, label_smoothing, block_b,
-                         interpret, mesh)
-    return jnp.sum(wnll) / jnp.maximum(jnp.sum(w), 1e-12)
+    # Scope tag for the device-time waterfall (telemetry/profile.py).
+    with jax.named_scope("fused_cross_entropy"):
+        cw, m = _canonicalize(logits, labels, class_weights, mask)
+        wnll, w = _persample(logits, labels, cw, m, label_smoothing,
+                             block_b, interpret, mesh)
+        return jnp.sum(wnll) / jnp.maximum(jnp.sum(w), 1e-12)
 
 
 def _ce_fwd(logits, labels, class_weights, mask, label_smoothing, block_b,
@@ -200,11 +202,12 @@ def _ce_fwd(logits, labels, class_weights, mask, label_smoothing, block_b,
     if interpret is None:
         from tpuic.kernels import default_interpret
         interpret = default_interpret()
-    cw, m = _canonicalize(logits, labels, class_weights, mask)
-    wnll, w = _persample(logits, labels, cw, m, label_smoothing, block_b,
-                         interpret, mesh)
-    sum_w = jnp.sum(w)
-    loss = jnp.sum(wnll) / jnp.maximum(sum_w, 1e-12)
+    with jax.named_scope("fused_cross_entropy"):
+        cw, m = _canonicalize(logits, labels, class_weights, mask)
+        wnll, w = _persample(logits, labels, cw, m, label_smoothing,
+                             block_b, interpret, mesh)
+        sum_w = jnp.sum(w)
+        loss = jnp.sum(wnll) / jnp.maximum(sum_w, 1e-12)
     return loss, (logits, labels, cw, m, sum_w)
 
 

@@ -875,10 +875,14 @@ def flash_attention(q, k, v, block_q: Optional[int] = None,
     count when the inputs carry caller-side padding (ulysses)."""
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     fwd, _ = _select_kernels(q.shape[2], q.shape[3])
-    return _batch_parallel(
-        lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
-                                 static_valid=valid_len),
-        mesh, interpret, 1, q, k, v)
+    # Scope tag for the device-time waterfall (telemetry/profile.py):
+    # the Pallas custom-call rolls up under 'flash_attention' instead of
+    # an anonymous custom-call.
+    with jax.named_scope("flash_attention"):
+        return _batch_parallel(
+            lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
+                                     static_valid=valid_len),
+            mesh, interpret, 1, q, k, v)
 
 
 def _batch_parallel(fn, mesh, interpret, n_out, *operands):
@@ -904,11 +908,12 @@ def _batch_parallel(fn, mesh, interpret, n_out, *operands):
 def _vjp_fwd(q, k, v, block_q, block_k, interpret, mesh, valid_len=None):
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     fwd, _ = _select_kernels(q.shape[2], q.shape[3])
-    out, lse = _batch_parallel(
-        lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
-                                 with_lse=True,
-                                 static_valid=valid_len),
-        mesh, interpret, 2, q, k, v)
+    with jax.named_scope("flash_attention"):
+        out, lse = _batch_parallel(
+            lambda interp, *ops: fwd(*ops, block_q, block_k, interp,
+                                     with_lse=True,
+                                     static_valid=valid_len),
+            mesh, interpret, 2, q, k, v)
     return out, (q, k, v, out, lse)
 
 
@@ -917,10 +922,11 @@ def _vjp_bwd(block_q, block_k, interpret, mesh, valid_len, res, g):
     # Same resolution as the forward: lse was padded with these blocks.
     block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     _, bwd = _select_kernels(q.shape[2], q.shape[3])
-    return _batch_parallel(
-        lambda interp, *ops: bwd(*ops, block_q, block_k, interp,
-                                 static_valid=valid_len),
-        mesh, interpret, 3, q, k, v, out, lse, g)
+    with jax.named_scope("flash_attention_bwd"):
+        return _batch_parallel(
+            lambda interp, *ops: bwd(*ops, block_q, block_k, interp,
+                                     static_valid=valid_len),
+            mesh, interpret, 3, q, k, v, out, lse, g)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

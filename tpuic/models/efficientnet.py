@@ -19,6 +19,7 @@ import math
 from functools import partial
 from typing import Any, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -167,7 +168,10 @@ class EfficientNet(nn.Module):
         head = _round_filters(1280, self.width_mult)
         x = nn.Conv(head, (1, 1), use_bias=False, **kw, name="head_conv")(x)
         x = nn.swish(bn(name="head_bn")(x))
-        x = jnp.mean(x, axis=(1, 2))
+        # 'gap' scope: the pool is the only phase flax's module path
+        # does not name (device-time waterfall, telemetry/profile.py).
+        with jax.named_scope("gap"):
+            x = jnp.mean(x, axis=(1, 2))
         return x.astype(jnp.float32)
 
 

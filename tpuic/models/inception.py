@@ -19,6 +19,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -198,7 +199,10 @@ class InceptionV3(nn.Module):
         x = InceptionD(conv_kw=kw, name="mixed7a")(x, train)
         x = InceptionE(conv_kw=kw, name="mixed7b")(x, train)
         x = InceptionE(conv_kw=kw, name="mixed7c")(x, train)
-        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # [B, 2048]
+        # 'gap' scope: the pool is the only phase flax's module path
+        # does not name (device-time waterfall, telemetry/profile.py).
+        with jax.named_scope("gap"):
+            x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # [B, 2048]
         if self.aux_classes and train:
             return x, aux
         return x

@@ -25,6 +25,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Sequence
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -110,31 +111,39 @@ class ResNet(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
         x = x.astype(self.dtype)
-        if self.small_stem:
-            x = nn.Conv(self.num_filters, (3, 3), padding=1, use_bias=False,
-                        **kw, name="conv1")(x)
-        elif self.space_to_depth:
-            b, h, w, c = x.shape
-            if h % 2 or w % 2:
-                raise ValueError(
-                    f"space_to_depth stem needs even H/W, got {(h, w)}")
-            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
-            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
-                                                      4 * c)
-            # Taps of output row oi cover original rows 2oi-3..2oi+3; with
-            # the kernel zero-padded to 8 the window is 2(oi-2)..2oi+3 —
-            # four s2d rows, hence 4x4 stride-1 with (2, 1) padding.
-            x = nn.Conv(self.num_filters, (4, 4), strides=(1, 1),
-                        padding=((2, 1), (2, 1)), use_bias=False, **kw,
-                        name="conv1")(x)
-        else:
-            x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2), padding=3,
-                        use_bias=False, **kw, name="conv1")(x)
-        x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
-                       f32_stats=self.bn_f32_stats, **kw, name="bn1")(x)
-        x = nn.relu(x)
-        if not self.small_stem:
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # jax.named_scope tags ('stem'/'gap') thread the structural
+        # phases flax's module path does not name into the HLO op
+        # metadata — the device-time waterfall (telemetry/profile.py)
+        # rolls layers up from exactly these paths; the blocks below are
+        # already scoped by their flax module names (layerN_i).
+        with jax.named_scope("stem"):
+            if self.small_stem:
+                x = nn.Conv(self.num_filters, (3, 3), padding=1,
+                            use_bias=False, **kw, name="conv1")(x)
+            elif self.space_to_depth:
+                b, h, w, c = x.shape
+                if h % 2 or w % 2:
+                    raise ValueError(
+                        f"space_to_depth stem needs even H/W, got {(h, w)}")
+                x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+                x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                          4 * c)
+                # Taps of output row oi cover original rows 2oi-3..2oi+3;
+                # with the kernel zero-padded to 8 the window is
+                # 2(oi-2)..2oi+3 — four s2d rows, hence 4x4 stride-1 with
+                # (2, 1) padding.
+                x = nn.Conv(self.num_filters, (4, 4), strides=(1, 1),
+                            padding=((2, 1), (2, 1)), use_bias=False, **kw,
+                            name="conv1")(x)
+            else:
+                x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
+                            padding=3, use_bias=False, **kw, name="conv1")(x)
+            x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
+                           f32_stats=self.bn_f32_stats, **kw, name="bn1")(x)
+            x = nn.relu(x)
+            if not self.small_stem:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                                padding=((1, 1), (1, 1)))
         for stage, n_blocks in enumerate(self.stage_sizes):
             for i in range(n_blocks):
                 strides = 2 if stage > 0 and i == 0 else 1
@@ -142,7 +151,8 @@ class ResNet(nn.Module):
                                self.bn_momentum, self.bn_eps, self.dtype,
                                self.param_dtype, self.bn_f32_stats,
                                name=f"layer{stage + 1}_{i}")(x, train)
-        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        with jax.named_scope("gap"):
+            x = jnp.mean(x, axis=(1, 2))  # global average pool
         return x.astype(jnp.float32)
 
 

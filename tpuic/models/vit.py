@@ -146,6 +146,7 @@ class MultiHeadAttention(nn.Module):
         else:
             scale = 1.0 / np.sqrt(head_dim)
 
+            @jax.named_scope("attention_core")
             def core(q, k, v):
                 logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
                 probs = nn.softmax(logits.astype(jnp.float32),
@@ -266,17 +267,22 @@ class ViT(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         B = x.shape[0]
         x = x.astype(self.dtype)
-        x = nn.Conv(self.hidden, (self.patch, self.patch),
-                    strides=(self.patch, self.patch), dtype=self.dtype,
-                    param_dtype=self.param_dtype, name="patch_embed")(x)
-        x = x.reshape(B, -1, self.hidden)  # [B, N, D]
-        cls = self.param("cls", nn.initializers.zeros,
-                         (1, 1, self.hidden), self.param_dtype)
-        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.hidden)
-                                              ).astype(self.dtype), x], axis=1)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, x.shape[1], self.hidden), self.param_dtype)
-        x = x + pos.astype(self.dtype)
+        # 'tokenize' names the patchify/cls/pos phase for the device-time
+        # waterfall (telemetry/profile.py); the encoder blocks below are
+        # already scoped by their flax module names (blockN).
+        with jax.named_scope("tokenize"):
+            x = nn.Conv(self.hidden, (self.patch, self.patch),
+                        strides=(self.patch, self.patch), dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="patch_embed")(x)
+            x = x.reshape(B, -1, self.hidden)  # [B, N, D]
+            cls = self.param("cls", nn.initializers.zeros,
+                             (1, 1, self.hidden), self.param_dtype)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (B, 1, self.hidden)
+                                  ).astype(self.dtype), x], axis=1)
+            pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                             (1, x.shape[1], self.hidden), self.param_dtype)
+            x = x + pos.astype(self.dtype)
         # static_argnums counts self: (self, x, deterministic) -> 2.
         block_cls = (nn.remat(EncoderBlock, static_argnums=(2,))
                      if self.remat_blocks else EncoderBlock)
@@ -292,9 +298,10 @@ class ViT(nn.Module):
                           remat_core=self.remat_core,
                           remat_mlp=self.remat_mlp,
                           name=f"block{i}")(x, not train)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln_final")(x)
-        return x[:, 0].astype(jnp.float32)
+        with jax.named_scope("cls_pool"):
+            x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                             name="ln_final")(x)
+            return x[:, 0].astype(jnp.float32)
 
 
 def vit_b16(**kw) -> ViT:

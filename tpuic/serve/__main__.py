@@ -361,7 +361,12 @@ def main(argv=None) -> int:
                  else None),
             admission=(admission_ctl.state() if admission_ctl is not None
                        else None),
-            memory=mem_sampler.snapshot())
+            memory=mem_sampler.snapshot(),
+            # Device-time attribution (telemetry/profile.py): the
+            # largest bucket executable's roofline waterfall, scaled to
+            # the span ledger's measured device phase — scrape-time
+            # only, never on the request path.
+            profile=engine.profile_waterfall())
 
     prom_server = None
     if args.prom_port:

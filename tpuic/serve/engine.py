@@ -363,8 +363,64 @@ class InferenceEngine:
             exe = self._jax.jit(self._forward).lower(
                 self._variables, spec).compile()
             self.stats.record_compile(bucket, time.perf_counter() - t0)
+            # Roofline context where the runtime exposes it: the
+            # AOT-lowered executable's FLOPs/bytes per call
+            # (docs/observability.md, "Device-time attribution").
+            # Best-effort — a backend without cost analysis serves
+            # identically, just without the exposition rows.
+            try:
+                from tpuic.telemetry.goodput import cost_analysis_dict
+                ca = cost_analysis_dict(exe)
+                self.stats.record_cost(bucket,
+                                       float(ca.get("flops", 0.0)),
+                                       float(ca.get("bytes accessed",
+                                                    0.0)))
+            except Exception:
+                pass
             self._executables[bucket] = exe
             return exe
+
+    def profile_waterfall(self):
+        """Per-op-class device-time waterfall of the largest warmed
+        bucket executable (telemetry/profile.py), with the measured
+        span-ledger ``device`` phase as the per-call device time —
+        ``device_time_ms{op_class}`` rows in the serve exposition.
+        None until a bucket has compiled; best-effort (a backend
+        without ``as_text``/cost analysis serves identically)."""
+        if not self._executables:
+            return None
+        try:
+            from tpuic.telemetry.goodput import (cost_analysis_dict,
+                                                 hbm_bandwidth, peak_flops)
+            from tpuic.telemetry.profile import (attribute_device_time,
+                                                 hlo_waterfall)
+            bucket = max(self._executables)
+            cached = getattr(self, "_profile_model_wf", None)
+            if cached is None or cached.get("bucket") != bucket:
+                exe = self._executables[bucket]
+                try:
+                    cost = cost_analysis_dict(exe)
+                except Exception:
+                    cost = {}
+                dev = self._jax.devices()[0]
+                cached = hlo_waterfall(
+                    exe.as_text(),
+                    total_flops=float(cost.get("flops", 0.0)),
+                    peak=peak_flops(dev),
+                    hbm_bytes_per_s=hbm_bandwidth(dev))
+                cached["bucket"] = bucket
+                # HLO parse cached per bucket: scrapes only re-scale it
+                # onto the current measured device phase.
+                self._profile_model_wf = cached
+            wf = cached
+            meter = self.stats.spans.get("device")
+            if meter is not None and meter.count:
+                per_call_ms = 1000.0 * meter.total / meter.count
+                wf = attribute_device_time(wf, [per_call_ms])
+                wf["bucket"] = bucket
+            return wf
+        except Exception:
+            return None
 
     def _executable_for(self, bucket: int):
         exe = self._executables.get(bucket)

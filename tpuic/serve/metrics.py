@@ -81,6 +81,14 @@ class ServeStats:
             self._est = 0.0            # cached estimated_service_s
             self._est_t = float("-inf")
             self._t0 = time.monotonic()
+        # Per-bucket compiled-executable cost analysis (engine._compile
+        # records it where the runtime exposes cost_analysis):
+        # {bucket: {"flops", "bytes", "intensity"}}.  Deliberately a
+        # property of the executables, not the measurement window — it
+        # is (re)assigned outside the reset-scoped block so reset()
+        # between load phases keeps the roofline context.
+        if not hasattr(self, "executable_cost"):
+            self.executable_cost: Dict[int, dict] = {}
 
     # -- engine-side updates -------------------------------------------
     def record_compile(self, bucket: int, seconds: float) -> None:
@@ -93,6 +101,17 @@ class ServeStats:
     def record_cache_hit(self) -> None:
         with self._lock:
             self.cache_hits += 1
+
+    def record_cost(self, bucket: int, flops: float, bytes_: float) -> None:
+        """Compiled cost analysis of one bucket executable (flops/bytes
+        per call) — the roofline context the exposition renders as
+        ``executable_{flops,bytes,intensity}{bucket=...}``."""
+        from tpuic.telemetry.goodput import roofline_intensity
+        inten = roofline_intensity(flops, bytes_)
+        with self._lock:
+            self.executable_cost[int(bucket)] = {
+                "flops": float(flops), "bytes": float(bytes_),
+                "intensity": round(inten, 3) if inten is not None else None}
 
     def record_reject(self, cause: str = "queue_full",
                       priority: str = "normal") -> None:
@@ -191,5 +210,7 @@ class ServeStats:
                 "rejected": self.rejected,
                 "rejected_by": {c: dict(sorted(p.items())) for c, p in
                                 sorted(self.rejected_by.items())},
+                "executable_cost": {str(k): dict(v) for k, v in
+                                    sorted(self.executable_cost.items())},
                 "elapsed_s": round(elapsed, 3),
             }

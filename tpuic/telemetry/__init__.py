@@ -55,8 +55,10 @@ from tpuic.telemetry.events import (Event, EventBus, JsonlSink,  # noqa: F401
 from tpuic.telemetry.flight import (FlightRecorder,  # noqa: F401
                                     install_flight_recorder)
 from tpuic.telemetry.goodput import (GoodputTracker,  # noqa: F401
-                                     PEAK_FLOPS, analytic_flops_per_step,
-                                     peak_flops)
+                                     HBM_GBPS, PEAK_FLOPS,
+                                     analytic_flops_per_step,
+                                     hbm_bandwidth, peak_flops,
+                                     roofline_intensity)
 from tpuic.telemetry.memory import MemorySampler  # noqa: F401
 from tpuic.telemetry.slo import (Objective, SLOTracker,  # noqa: F401
                                  parse_objectives)
@@ -144,6 +146,36 @@ class TrainTelemetry:
             self.slo = SLOTracker(parse_objectives(
                 slo_specs, allowed=("train_step",)))
             self._unsubs.append(self.slo.attach(bus))
+        # Device-time attribution (telemetry/profile.py,
+        # docs/observability.md "Device-time attribution"): with
+        # run.trace_analyze set, captured trace windows are auto-analyzed
+        # into a per-op-class waterfall ('profile' events) and a final
+        # analysis runs at flush().  The Trainer wires the HLO provider
+        # (the AOT-lowered train step) after construction; until then
+        # the analyzer still ingests step device_ms — one deque append
+        # per step, zero syncs, zero compiles (test-asserted on-vs-off).
+        self.profile = None
+        if getattr(run_cfg, "trace_analyze", False):
+            # Imported lazily so `python -m tpuic.telemetry.profile`
+            # does not re-import its own module through this package.
+            from tpuic.telemetry.profile import CaptureAnalyzer
+            # PER-DEVICE peak/bandwidth, NOT x n_devices: the analyzed
+            # HLO is the SPMD-partitioned per-device program and the
+            # measured step time is the wall clock of its parallel
+            # execution — one device's roofline is the right ruler.
+            self.profile = CaptureAnalyzer(
+                peak=peak_flops(device),
+                hbm_bytes_per_s=hbm_bandwidth(device),
+                model_name=model_name, image_size=image_size,
+                global_batch=global_batch,
+                n_devices=max(1, int(n_devices)))
+            # 'trace' too: steps measured inside a profiler window are
+            # excluded from the waterfall's device distribution (the
+            # analyzer's observer-effect taint).  Subscribed BEFORE the
+            # tracer below, so the window-open/close ordering it sees is
+            # exact.
+            self._unsubs.append(bus.subscribe(self.profile.on_event,
+                                              kinds=("step", "trace")))
         trace_dir = os.environ.get("TPUIC_TRACE", "") or \
             getattr(run_cfg, "trace_dir", "") or ""
         self.tracer: Optional[TraceTrigger] = None
@@ -155,7 +187,9 @@ class TrainTelemetry:
                 keep=int(getattr(run_cfg, "trace_keep", 4)),
                 # TPUIC_TRACE=dir is the manual override: capture one
                 # window immediately instead of waiting for a regression.
-                force_first=bool(os.environ.get("TPUIC_TRACE")))
+                force_first=bool(os.environ.get("TPUIC_TRACE")),
+                on_capture=(self.profile.on_capture
+                            if self.profile is not None else None))
             self._unsubs.append(bus.subscribe(self.tracer.on_event,
                                               kinds=("step",)))
         if tb is not None:
@@ -167,9 +201,14 @@ class TrainTelemetry:
             self._unsubs.append(bus.subscribe(
                 tbs, kinds=("step", "skip", "rollback", "quarantine",
                             "goodput", "restart", "slo", "memory",
-                            "serve_batch", "serve_span")))
+                            "serve_batch", "serve_span", "profile")))
 
     def flush(self) -> None:
+        if self.profile is not None:
+            # Run-end device-time analysis over the full step window
+            # (final=True) BEFORE the sinks flush, so the event lands in
+            # this run's JSONL.  The analyzer contains its own failures.
+            self.profile.finalize()
         for s in self._sinks:
             s.flush()
 

@@ -43,7 +43,7 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
                "serve_span", "slo", "admission", "trace", "goodput",
-               "restart", "heartbeat", "memory", "flight_dump")
+               "restart", "heartbeat", "memory", "flight_dump", "profile")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -348,6 +348,20 @@ class TensorBoardSink:
                     scalars[f"slo_{name}_{field}"] = float(d[field])
             if scalars:
                 self._tb.scalars(int(d.get("step", self._step)), **scalars)
+        elif ev.kind == "profile":
+            # Device-time waterfall (telemetry/profile.py): per-op-class
+            # device milliseconds as scalars; layer rollups and verdicts
+            # stay in JSONL/prom (a per-layer TB curve per analysis
+            # would be noise).
+            scalars = {}
+            for cls, c in (d.get("classes") or {}).items():
+                if isinstance(c, dict) and c.get("ms") is not None:
+                    scalars[f"device_time_ms_{cls}"] = float(c["ms"])
+            if d.get("device_ms_per_step") is not None:
+                scalars["device_ms_per_step"] = float(
+                    d["device_ms_per_step"])
+            if scalars:
+                self._tb.scalars(self._step, **scalars)
 
 
 # -- the process-global bus --------------------------------------------------

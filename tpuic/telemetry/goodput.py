@@ -64,6 +64,106 @@ def peak_flops(device) -> float:
     return 1e12
 
 
+# HBM bandwidth in GB/s per chip by device kind (public spec sheets) —
+# the second axis of the roofline every device-time verdict in
+# telemetry/profile.py is judged against.  Golden-value-pinned in
+# tests/test_profile.py exactly like PEAK_FLOPS above: an MFU claim and
+# a "this op class is HBM-bound" claim must come from the same tables.
+HBM_GBPS = {
+    "TPU v5 lite": 819,     # v5e
+    "TPU v5e": 819,
+    "TPU v5": 2765,         # v5p
+    "TPU v4": 1228,
+    "TPU v6 lite": 1640,    # v6e / Trillium
+    "cpu": 50,              # nominal DDR-class; keeps the metric finite in CI
+}
+
+
+def hbm_bandwidth(device) -> float:
+    """HBM bytes/s for a jax device (50 GB/s nominal fallback)."""
+    kind = getattr(device, "device_kind", "cpu") if device is not None else "cpu"
+    for k, v in HBM_GBPS.items():
+        if str(kind).lower().startswith(k.lower()):
+            return v * 1e9
+    return 50e9
+
+
+def roofline_intensity(flops: float, bytes_accessed: float) -> Optional[float]:
+    """Arithmetic intensity (FLOPs per HBM byte), or None when no bytes
+    move.  THE shared formula: telemetry/profile.py's per-class verdicts
+    and bench.py's detail both call this instead of growing two."""
+    if not bytes_accessed or bytes_accessed <= 0:
+        return None
+    return float(flops) / float(bytes_accessed)
+
+
+def ridge_intensity(peak: float, hbm_bytes_per_s: float) -> float:
+    """The roofline ridge point (FLOPs/byte): below it a kernel at peak
+    bandwidth cannot reach peak FLOPs — it is HBM-bound by arithmetic."""
+    return float(peak) / max(1.0, float(hbm_bytes_per_s))
+
+
+def roofline_verdict(flops: float, bytes_accessed: float, peak: float,
+                     hbm_bytes_per_s: float) -> str:
+    """'compute-bound' | 'hbm-bound' | 'overhead' for a (FLOPs, bytes)
+    workload on a (peak, bandwidth) machine.  'overhead' means neither
+    axis is exercised (no flops AND no bytes — control flow, tuples,
+    host stalls booked to the device bucket)."""
+    if (not flops or flops <= 0) and (not bytes_accessed
+                                      or bytes_accessed <= 0):
+        return "overhead"
+    inten = roofline_intensity(flops, bytes_accessed)
+    if inten is None:  # flops but no bytes: register-resident compute
+        return "compute-bound"
+    return ("compute-bound"
+            if inten >= ridge_intensity(peak, hbm_bytes_per_s)
+            else "hbm-bound")
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one flat dict.
+
+    jax returns a list of per-device dicts on some backends (CPU) and a
+    plain dict on others; every consumer here (bench.py, profile.py,
+    serve/engine.py) wants the first device's view.  Raises whatever the
+    runtime raises when cost analysis is unsupported — callers guard."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca)
+
+
+def check_flops_drift(model_name: str, image_size: int, global_batch: int,
+                      compiled_flops: float, *, train: bool = True,
+                      tol: float = 0.10, warn=None) -> Optional[float]:
+    """Cross-check the analytic FLOPs table against the compiler's count.
+
+    Returns the relative drift ``|analytic - compiled| / compiled`` (None
+    when the model is unknown or the compiled count is unusable) and
+    WARNS — loudly, never raises — when it exceeds ``tol``: the analytic
+    table feeding every in-band MFU number silently mis-reports once the
+    models or the table drift apart, and until now nothing compared them
+    where both are available (bench.py and the profile analyzer do now).
+    """
+    if not compiled_flops or compiled_flops <= 0:
+        return None
+    analytic = analytic_flops_per_step(model_name, image_size, global_batch,
+                                       train=train)
+    if analytic is None:
+        return None
+    drift = abs(analytic - float(compiled_flops)) / float(compiled_flops)
+    if drift > tol:
+        import warnings
+        (warn or warnings.warn)(
+            f"analytic FLOPs table drifts {100.0 * drift:.1f}% from the "
+            f"compiler's count for model={model_name!r} "
+            f"(analytic {analytic:.3e} vs cost_analysis "
+            f"{float(compiled_flops):.3e} per step): MFU numbers derived "
+            "from the table are off by the same factor — update "
+            "FWD_FLOPS_PER_IMAGE in tpuic/telemetry/goodput.py")
+    return drift
+
+
 # Analytic forward GFLOPs per image at a canonical resolution
 # (published per-model numbers; prefix-matched so '-s2d'/'-cifar'
 # variants inherit the family figure unless listed).  The training

@@ -109,6 +109,43 @@ def memory_rows(memory: Optional[dict]) -> List[Tuple]:
     return rows
 
 
+_VERDICT_CODE = {"hbm-bound": 0.0, "compute-bound": 1.0, "overhead": -1.0}
+
+
+def profile_rows(waterfall: Optional[dict]) -> List[Tuple]:
+    """Device-time waterfall (telemetry/profile.py) -> exposition rows:
+    per op class, ``device_time_ms{op_class}`` / ``device_time_frac``
+    plus the roofline intensity and an encoded verdict
+    (1 = compute-bound, 0 = hbm-bound, -1 = overhead — numeric so a
+    dashboard can alert on a class flipping sides of the ridge).
+    Shared by the serve and train expositions; None renders nothing (a
+    run that never analyzed must not scrape as a zero waterfall)."""
+    rows: List[Tuple] = []
+    for cls, c in sorted((waterfall or {}).get("classes", {}).items()):
+        if not isinstance(c, dict):
+            continue
+        labels = {"op_class": cls}
+        rows.append(("device_time_ms", c.get("ms"), "gauge",
+                     "device time per op class from the last waterfall "
+                     "analysis (docs/observability.md, 'Device-time "
+                     "attribution')", labels))
+        rows.append(("device_time_frac", c.get("frac"), "gauge",
+                     "fraction of the device bucket per op class",
+                     labels))
+        rows.append(("roofline_intensity", c.get("intensity"), "gauge",
+                     "arithmetic intensity (FLOPs/HBM byte) per op class",
+                     labels))
+        rows.append(("roofline_verdict", _VERDICT_CODE.get(
+            c.get("verdict")), "gauge",
+            "roofline verdict per op class (1=compute-bound, "
+            "0=hbm-bound, -1=overhead)", labels))
+    if (waterfall or {}).get("device_ms_per_step") is not None:
+        rows.append(("device_ms_per_step", waterfall["device_ms_per_step"],
+                     "gauge", "mean measured device bucket the waterfall "
+                     "sums to", None))
+    return rows
+
+
 def _process_rss_row() -> Tuple:
     """The ``process_rss_bytes`` gauge both expositions render — host
     memory next to the device curve it eventually takes down.  Lazy
@@ -159,7 +196,8 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
                      heartbeat_age_s: Optional[float] = None,
                      slo: Optional[dict] = None,
                      admission: Optional[dict] = None,
-                     memory: Optional[dict] = None) -> str:
+                     memory: Optional[dict] = None,
+                     profile: Optional[dict] = None) -> str:
     """ServeStats.snapshot() -> Prometheus text.
 
     ``heartbeat_age_s``: seconds since the supervised-liveness heartbeat
@@ -171,7 +209,10 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     gauges; the rejected_total{cause,priority} split renders from the
     snapshot itself.
     ``memory``: a MemorySampler.snapshot() for the per-device
-    ``device_memory_bytes{device,kind}`` rows (telemetry/memory.py)."""
+    ``device_memory_bytes{device,kind}`` rows (telemetry/memory.py).
+    ``profile``: a device-time waterfall (telemetry/profile.py — the
+    engine's ``profile_waterfall()``) for ``device_time_ms{op_class}``
+    rows."""
     rows: List[Tuple] = [
         _process_rss_row(),
         ("heartbeat_age_seconds", heartbeat_age_s, "gauge",
@@ -216,6 +257,22 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     for bucket, n in (snapshot.get("batch_hist") or {}).items():
         rows.append(("batches_total", n, "counter",
                      "device calls per padding bucket", {"bucket": bucket}))
+    # Per-bucket executable cost analysis (serve/engine.py _compile):
+    # FLOPs/bytes/intensity of each AOT bucket — the roofline context
+    # for the span ledger's device phase.
+    for bucket, c in sorted((snapshot.get("executable_cost")
+                             or {}).items()):
+        labels = {"bucket": str(bucket)}
+        for field, help_ in (("flops", "compiled FLOPs per executable "
+                              "call, by padding bucket"),
+                             ("bytes", "compiled HBM bytes accessed per "
+                              "executable call, by padding bucket"),
+                             ("intensity", "arithmetic intensity "
+                              "(FLOPs/byte) per bucket executable")):
+            if c.get(field) is not None:
+                rows.append((f"executable_{field}", c[field], "gauge",
+                             help_, labels))
+    rows.extend(profile_rows(profile))
     rows.extend(admission_rows(snapshot, admission))
     rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
@@ -226,14 +283,17 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
                      prefix: str = "tpuic_train",
                      heartbeat_age_s: Optional[float] = None,
                      slo: Optional[dict] = None,
-                     memory: Optional[dict] = None) -> str:
+                     memory: Optional[dict] = None,
+                     profile: Optional[dict] = None) -> str:
     """GoodputTracker.report() (+ StepTimer.summary()) -> Prometheus text.
 
     ``heartbeat_age_s`` as in :func:`serve_exposition`; ``restart_count``
     comes from the report's ``restarts`` field (the supervisor restart
     this process announced at fit() start — runtime/supervisor.py).
     ``slo``: an SLOTracker.report() for the step-time objectives.
-    ``memory``: a MemorySampler.snapshot() (telemetry/memory.py)."""
+    ``memory``: a MemorySampler.snapshot() (telemetry/memory.py).
+    ``profile``: the last device-time waterfall (telemetry/profile.py,
+    ``CaptureAnalyzer.last``) for ``device_time_ms{op_class}`` rows."""
     rows: List[Tuple] = [
         _process_rss_row(),
         ("restart_count", report.get("restarts"), "counter",
@@ -267,6 +327,7 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
             rows.append((name, v, "gauge",
                          "step-time percentiles over the sliding window",
                          {"quantile": q}))
+    rows.extend(profile_rows(profile))
     rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
     return render(rows, prefix=prefix)

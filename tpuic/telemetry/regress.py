@@ -293,11 +293,16 @@ def make_baseline(trials: Sequence[Dict[str, float]],
 
 
 def compare(baseline: dict, fresh: Dict[str, float],
-            fresh_calibration: float) -> dict:
+            fresh_calibration: float, specs: Optional[dict] = None) -> dict:
     """Fresh metrics vs the committed baseline under the tolerance
     ladder.  Returns a report dict; ``report["regressed"]`` is the gate
     verdict and each regressed row names its metric — the CI failure
-    message is the report, not a bare exit code."""
+    message is the report, not a bare exit code.
+
+    ``specs`` overrides the gated-metric table (same shape as
+    METRIC_SPECS) — the roofline gate (telemetry/profile.py) runs its
+    op-class metrics through this exact machinery instead of growing a
+    second calibration/tolerance implementation."""
     base_cal = float(baseline.get("calibration_s") or 0.0)
     scale = 1.0
     cal_note = "no baseline calibration — absolute comparison"
@@ -316,7 +321,7 @@ def compare(baseline: dict, fresh: Dict[str, float],
             scale = min(max(scale, 1.0 / CAL_CLAMP), CAL_CLAMP)
             cal_note += f" — CLAMPED to {scale:.3f}: machines barely comparable"
     rows = []
-    for name, (direction, kind, floor) in METRIC_SPECS.items():
+    for name, (direction, kind, floor) in (specs or METRIC_SPECS).items():
         b = (baseline.get("metrics") or {}).get(name)
         f = fresh.get(name)
         if b is None or f is None:

@@ -25,10 +25,18 @@ Semantics (docs/observability.md):
   a trace per step.
 - Every transition publishes a ``trace`` event
   (``action``: started/stopped/error, ``path``, ``reason``/``ratio``).
+- **on_capture**: a hook called with the capture path after each window
+  closes cleanly — the device-time analyzer
+  (``tpuic.telemetry.profile.CaptureAnalyzer``) hangs here, so a
+  triggered trace is auto-analyzed into a ``profile`` event instead of
+  writing a directory and standing down.  A hook failure publishes a
+  ``trace`` event (``action: analyze_error``) and does NOT disable the
+  trigger: capture still works when analysis breaks.
 
 A failure to start/stop the profiler (e.g. the fit-level
 ``--profile-dir`` trace already active) is published as an error event
-and disables the trigger — observability must never kill the run.
+and disables the trigger — observability must never kill the run; a
+capture failure therefore still stands down cleanly, analyzed or not.
 """
 
 from __future__ import annotations
@@ -45,11 +53,12 @@ class TraceTrigger:
     def __init__(self, trace_dir: str, threshold: float = 3.0,
                  window: int = 64, warmup: int = 5, trace_steps: int = 3,
                  keep: int = 4, cooldown: int = 16, bus=None,
-                 force_first: bool = False) -> None:
+                 force_first: bool = False, on_capture=None) -> None:
         if bus is None:
             from tpuic.telemetry.events import bus as _global_bus
             bus = _global_bus
         self.bus = bus
+        self.on_capture = on_capture
         self.trace_dir = trace_dir
         self.threshold = float(threshold)
         self.warmup = max(2, int(warmup))
@@ -162,3 +171,12 @@ class TraceTrigger:
                              reason=str(e)[:200])
             return
         self.bus.publish("trace", action="stopped", path=path)
+        if self.on_capture is not None:
+            # Auto-analysis of the capture (telemetry/profile.py). An
+            # analyzer failure is reported, NOT escalated: the trigger
+            # keeps capturing — raw traces beat no traces.
+            try:
+                self.on_capture(path)
+            except Exception as e:
+                self.bus.publish("trace", action="analyze_error",
+                                 path=path, reason=str(e)[:200])

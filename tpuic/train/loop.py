@@ -218,6 +218,12 @@ class Trainer:
             cfg.run, model_name=mcfg.name, image_size=d.resize_size,
             global_batch=global_batch, n_devices=self.mesh.size,
             device=jax.devices()[0], tb=self.logger.tb)
+        if self.telemetry.profile is not None:
+            # Device-time attribution (telemetry/profile.py): hand the
+            # analyzer the REAL train step's AOT view. Called lazily
+            # (once, cached) from the capture/finalize hooks — never on
+            # the hot path.
+            self.telemetry.profile.hlo_provider = self._train_step_hlo
         # Non-finite rollback bookkeeping (docs/robustness.md): the jitted
         # step skips poisoned updates in-graph (train/step.py guard) and
         # counts the consecutive-skip streak in state.skip_count; the
@@ -229,6 +235,32 @@ class Trainer:
         self._quarantine_seen = 0
         self._last_skip_streak = 0
         self._steps_exhausted = False
+
+    def _train_step_hlo(self):
+        """(optimized HLO text, cost_analysis dict) of THE train step —
+        the device-time analyzer's model source (docs/observability.md,
+        "Device-time attribution").
+
+        Lowered against the batch geometry this run trains with (image
+        as float32, the decode-path contract; the packed uint8 path
+        differs only in the cast/augment prologue, which class-level
+        attribution absorbs into elementwise).  The compile is off the
+        hot path by construction — analysis hooks only — and hits the
+        persistent compilation cache when one is configured."""
+        from tpuic.telemetry.goodput import cost_analysis_dict
+        d = self.cfg.data
+        gb = self.train_loader.global_batch
+        sds = jax.ShapeDtypeStruct
+        batch = {"image": sds((gb, d.resize_size, d.resize_size, 3),
+                              np.float32),
+                 "label": sds((gb,), np.int32),
+                 "mask": sds((gb,), np.float32)}
+        compiled = self.train_step.lower(self.state, batch).compile()
+        try:
+            cost = cost_analysis_dict(compiled)
+        except Exception:
+            cost = {}
+        return compiled.as_text(), cost
 
     def _init_from_torch(self, path: str) -> None:
         """Pretrained-weight initialization from a torch checkpoint.
@@ -806,6 +838,12 @@ class Trainer:
             self.ckpt.wait()
             if self.telemetry.tracer is not None:
                 self.telemetry.tracer.finish()
+            if self.telemetry.profile is not None:
+                # Final device-time analysis BEFORE the final goodput
+                # event: the goodput publish drives the --prom-dump
+                # refresh, which must see the finished waterfall
+                # (finalize is idempotent; flush() backstops it).
+                self.telemetry.profile.finalize()
             # Final goodput report — the run's wall-time ledger
             # (productive/input/compile/checkpoint/skip/rollback/eval;
             # CI asserts the buckets sum to ~100% of wall).

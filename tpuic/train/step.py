@@ -240,48 +240,59 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                 lam = 1.0 - jnp.mean(box.astype(jnp.float32))
                 return out, lam
 
-            if optim_cfg.mixup_alpha > 0 and optim_cfg.cutmix_alpha > 0:
-                use_mix = jax.random.bernoulli(
-                    jax.random.fold_in(mix_rng, 3))
-                # tpuic-ok: TPU202 cond operands are fresh mix tensors,
-                # never the donated pass-through state; the skip guard
-                # stays a jnp.where select (the PR-2 bisect's actual fix)
-                images, lam = jax.lax.cond(  # tpuic-ok: TPU202
-                    use_mix, _mixup, _cutmix, images, partners)
-            elif optim_cfg.mixup_alpha > 0:
-                images, lam = _mixup(images, partners)
-            else:
-                images, lam = _cutmix(images, partners)
+            # Scope tag for the device-time waterfall (telemetry/
+            # profile.py): mix ops roll up under 'augment', apart from
+            # the model's own layers.
+            with jax.named_scope("augment"):
+                if optim_cfg.mixup_alpha > 0 and optim_cfg.cutmix_alpha > 0:
+                    use_mix = jax.random.bernoulli(
+                        jax.random.fold_in(mix_rng, 3))
+                    # tpuic-ok: TPU202 cond operands are fresh mix
+                    # tensors, never the donated pass-through state; the
+                    # skip guard stays a jnp.where select (the PR-2
+                    # bisect's actual fix)
+                    images, lam = jax.lax.cond(  # tpuic-ok: TPU202
+                        use_mix, _mixup, _cutmix, images, partners)
+                elif optim_cfg.mixup_alpha > 0:
+                    images, lam = _mixup(images, partners)
+                else:
+                    images, lam = _cutmix(images, partners)
 
         # Random erasing (Zhong et al., 2020), per SAMPLE: with prob p a
         # random box (area 2-33%, aspect 0.3-3.3) is zeroed — zero IS the
         # per-channel mean after the pipeline's normalization. Labels are
         # untouched, so it composes freely with mixup/cutmix above.
         if optim_cfg.random_erase > 0:
-            er_rng = jax.random.fold_in(dropout_rng, 0x6572)
-            b, h, w = images.shape[0], images.shape[1], images.shape[2]
-            ks = jax.random.split(er_rng, 5)
-            area = jax.random.uniform(ks[0], (b,), minval=0.02, maxval=0.33)
-            log_ar = jax.random.uniform(ks[1], (b,),
-                                        minval=jnp.log(0.3),
-                                        maxval=jnp.log(3.3))
-            ar = jnp.exp(log_ar)
-            bh = jnp.clip(jnp.sqrt(area * h * w * ar), 1, h)   # [B]
-            bw = jnp.clip(jnp.sqrt(area * h * w / ar), 1, w)
-            cy = jax.random.uniform(ks[2], (b,)) * h
-            cx = jax.random.uniform(ks[3], (b,)) * w
-            y0, y1 = jnp.clip(cy - bh / 2, 0, h), jnp.clip(cy + bh / 2, 0, h)
-            x0, x1 = jnp.clip(cx - bw / 2, 0, w), jnp.clip(cx + bw / 2, 0, w)
-            apply = jax.random.bernoulli(ks[4], optim_cfg.random_erase, (b,))
-            ys = jnp.arange(h, dtype=jnp.float32)
-            xs = jnp.arange(w, dtype=jnp.float32)
-            box = ((ys[None, :, None] >= y0[:, None, None])
-                   & (ys[None, :, None] < y1[:, None, None])
-                   & (xs[None, None, :] >= x0[:, None, None])
-                   & (xs[None, None, :] < x1[:, None, None])
-                   & apply[:, None, None])                     # [B,H,W]
-            images = jnp.where(box[..., None], jnp.zeros_like(images),
-                               images)
+            with jax.named_scope("augment"):
+                er_rng = jax.random.fold_in(dropout_rng, 0x6572)
+                b, h, w = (images.shape[0], images.shape[1],
+                           images.shape[2])
+                ks = jax.random.split(er_rng, 5)
+                area = jax.random.uniform(ks[0], (b,), minval=0.02,
+                                          maxval=0.33)
+                log_ar = jax.random.uniform(ks[1], (b,),
+                                            minval=jnp.log(0.3),
+                                            maxval=jnp.log(3.3))
+                ar = jnp.exp(log_ar)
+                bh = jnp.clip(jnp.sqrt(area * h * w * ar), 1, h)   # [B]
+                bw = jnp.clip(jnp.sqrt(area * h * w / ar), 1, w)
+                cy = jax.random.uniform(ks[2], (b,)) * h
+                cx = jax.random.uniform(ks[3], (b,)) * w
+                y0, y1 = (jnp.clip(cy - bh / 2, 0, h),
+                          jnp.clip(cy + bh / 2, 0, h))
+                x0, x1 = (jnp.clip(cx - bw / 2, 0, w),
+                          jnp.clip(cx + bw / 2, 0, w))
+                apply = jax.random.bernoulli(ks[4],
+                                             optim_cfg.random_erase, (b,))
+                ys = jnp.arange(h, dtype=jnp.float32)
+                xs = jnp.arange(w, dtype=jnp.float32)
+                box = ((ys[None, :, None] >= y0[:, None, None])
+                       & (ys[None, :, None] < y1[:, None, None])
+                       & (xs[None, None, :] >= x0[:, None, None])
+                       & (xs[None, None, :] < x1[:, None, None])
+                       & apply[:, None, None])                 # [B,H,W]
+                images = jnp.where(box[..., None],
+                                   jnp.zeros_like(images), images)
 
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}
@@ -305,23 +316,30 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                               params["backbone"])}
             out, mutated = forward(params, state.batch_stats, images,
                                    dropout_rng)
-            loss = classification_loss(out, labels, class_weights=class_weights,
-                                       mask=mask, aux_weight=aux_w,
-                                       label_smoothing=smoothing,
-                                       impl="fused" if optim_cfg.fused_loss
-                                       else "reference", mesh=mesh)
-            if labels_mix is not None:
-                loss_b = classification_loss(
-                    out, labels_mix, class_weights=class_weights, mask=mask,
+            # 'loss' scope: CE (+aux) ops separate from the backbone's
+            # layers in the device-time waterfall (telemetry/profile.py).
+            with jax.named_scope("loss"):
+                loss = classification_loss(
+                    out, labels, class_weights=class_weights, mask=mask,
                     aux_weight=aux_w, label_smoothing=smoothing,
-                    impl="fused" if optim_cfg.fused_loss else "reference",
-                    mesh=mesh)
-                loss = lam * loss + (1.0 - lam) * loss_b
-            routers = _moe_router_stats(mutated.get("intermediates", {}))
-            if routers and model_cfg.moe_aux_weight:
-                from tpuic.models.moe import switch_aux_loss
-                aux = sum(switch_aux_loss(p, o, mask) for p, o in routers)
-                loss = loss + model_cfg.moe_aux_weight * aux / len(routers)
+                    impl="fused" if optim_cfg.fused_loss
+                    else "reference", mesh=mesh)
+                if labels_mix is not None:
+                    loss_b = classification_loss(
+                        out, labels_mix, class_weights=class_weights,
+                        mask=mask, aux_weight=aux_w,
+                        label_smoothing=smoothing,
+                        impl="fused" if optim_cfg.fused_loss
+                        else "reference", mesh=mesh)
+                    loss = lam * loss + (1.0 - lam) * loss_b
+                routers = _moe_router_stats(mutated.get("intermediates",
+                                                        {}))
+                if routers and model_cfg.moe_aux_weight:
+                    from tpuic.models.moe import switch_aux_loss
+                    aux = sum(switch_aux_loss(p, o, mask)
+                              for p, o in routers)
+                    loss = loss + (model_cfg.moe_aux_weight * aux
+                                   / len(routers))
             logits = out[0] if isinstance(out, tuple) else out
             return loss, (mutated.get("batch_stats", state.batch_stats), logits)
 
@@ -329,6 +347,7 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             loss_fn, has_aux=True)(state.params)
         grad_norm = optax.global_norm(grads)
 
+        @jax.named_scope("optimizer_update")
         def _apply_update(st: TrainState) -> TrainState:
             new_state = st.apply_gradients(grads=grads).replace(
                 batch_stats=new_stats)
@@ -383,12 +402,13 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                     finite, 0, state.skip_count + 1).astype(jnp.int32))
         else:
             new_state = _apply_update(state)
-        acc = accuracy(logits, labels)
-        if mask is not None:
-            m = mask.astype(jnp.float32)
-            acc_mean = jnp.sum(acc * m) / jnp.maximum(jnp.sum(m), 1.0)
-        else:
-            acc_mean = jnp.mean(acc)
+        with jax.named_scope("step_metrics"):
+            acc = accuracy(logits, labels)
+            if mask is not None:
+                m = mask.astype(jnp.float32)
+                acc_mean = jnp.sum(acc * m) / jnp.maximum(jnp.sum(m), 1.0)
+            else:
+                acc_mean = jnp.mean(acc)
         metrics = {"loss": loss, "accuracy": acc_mean,
                    "grad_norm": grad_norm}
         if optim_cfg.skip_nonfinite:
@@ -454,9 +474,10 @@ def make_eval_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         variables = {"params": state.inference_params,
                      "batch_stats": state.batch_stats}
         logits = state.apply_fn(variables, images, train=False)
-        acc = accuracy(logits, labels)
-        loss = classification_loss(logits, labels, class_weights=class_weights,
-                                   mask=m)
+        with jax.named_scope("eval_metrics"):
+            acc = accuracy(logits, labels)
+            loss = classification_loss(logits, labels,
+                                       class_weights=class_weights, mask=m)
         if class_weights is not None:
             w = jnp.sum(jax.nn.one_hot(labels, logits.shape[-1],
                                        dtype=jnp.float32)

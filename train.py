@@ -198,6 +198,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 disables the automatic trigger)")
     p.add_argument("--trace-steps", type=int, default=3,
                    help="steps each triggered trace window covers")
+    p.add_argument("--trace-analyze", action="store_true",
+                   help="auto-analyze captured trace windows (and the "
+                        "full run at exit) into a per-op-class device-"
+                        "time waterfall with roofline verdicts "
+                        "(telemetry/profile.py): 'profile' events in "
+                        "the metrics JSONL, TensorBoard scalars, and "
+                        "device_time_ms{op_class} rows in --prom-dump")
     p.add_argument("--prom-dump", default="",
                    help="write the train Prometheus exposition (goodput "
                         "fractions, MFU, step-time percentiles, restart "
@@ -279,6 +286,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       trace_dir=args.trace_dir,
                       trace_threshold=args.trace_threshold,
                       trace_steps=args.trace_steps,
+                      trace_analyze=args.trace_analyze,
                       slo=args.slo),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
@@ -340,12 +348,14 @@ def main(argv=None) -> int:
             def _prom_dump(ev) -> None:
                 hb = trainer.telemetry.heartbeat
                 slo = trainer.telemetry.slo
+                prof = trainer.telemetry.profile
                 write_exposition(args.prom_dump, train_exposition(
                     dict(ev.data),
                     trainer.telemetry.steptime.summary(),
                     heartbeat_age_s=hb.age_s() if hb is not None else None,
                     slo=slo.report() if slo is not None else None,
-                    memory=trainer.telemetry.memory.snapshot()))
+                    memory=trainer.telemetry.memory.snapshot(),
+                    profile=prof.last if prof is not None else None))
             subscribe(_prom_dump, kinds=("goodput",))
     try:
         best = trainer.fit()
