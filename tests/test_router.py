@@ -1,0 +1,526 @@
+"""tpuic.serve.router: health-checked routing, breakers, retry budget,
+kill-mid-flight failover — against fake stdlib replicas, no jax.
+
+The router is a stdlib-only front tier (the supervisor-parent rule), so
+everything here drives it with in-process fake replica servers speaking
+the socket-JSONL transport: real sockets, real reader threads, real
+breaker state machines — and a ``kill()`` that drops connections as
+abruptly as a SIGKILL would.  The full two-real-replica storm (spawned
+engines, SIGKILL mid-storm, prom-scraped health) is CI's
+``scripts/router_soak.py``.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpuic.serve.admission import (AdmissionError, AdmissionRejected,
+                                   DeadlineExceeded, ReplicaLost)
+from tpuic.serve.router import CircuitBreaker, RetryBudget, Router
+from tpuic.serve import wire
+
+
+# -- fake replica ------------------------------------------------------------
+class FakeReplica:
+    """Stdlib socket server speaking the replica transport: pongs
+    pings, answers requests via ``respond`` (default: a canned result
+    record), optionally *holds* requests (never answers — in-flight
+    fodder for failover tests).  ``kill()`` drops every connection and
+    the listener abruptly, the SIGKILL shape."""
+
+    def __init__(self, *, hold: bool = False, respond=None,
+                 port: int = 0) -> None:
+        self.hold = hold
+        self.respond = respond or (lambda req: {
+            "id": req["id"], "pred": "0", "prob": 1.0,
+            "topk": [["0", 1.0]]})
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self.srv = socket.create_server(("127.0.0.1", port))
+                break
+            except OSError:
+                # Rebinding a just-killed replica's fixed port: the old
+                # accept syscall may not have released the fd yet.
+                if port == 0 or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        self.port = self.srv.getsockname()[1]
+        self.held = []          # requests received while hold=True
+        self.seen = []          # every non-ping request
+        self._conns = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.srv.settimeout(0.2)
+                conn, _ = self.srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn) -> None:
+        buf = b""
+        conn.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                chunk = conn.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            *lines, buf = (buf + chunk).split(b"\n")
+            for raw in lines:
+                if not raw.strip():
+                    continue
+                req = json.loads(raw)
+                if req.get("op") == "ping":
+                    self._send(conn, {"id": req.get("id"), "op": "pong",
+                                      "queue_depth": 0})
+                    continue
+                self.seen.append(req)
+                if self.hold:
+                    self.held.append(req)
+                    continue
+                self._send(conn, self.respond(req))
+
+    def _send(self, conn, rec) -> None:
+        try:
+            conn.sendall((json.dumps(rec) + "\n").encode())
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Abrupt death: listener and every connection dropped."""
+        self._stop.set()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+        self._accept.join(timeout=2.0)  # release the listener fd
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def _router(tmp_path, fakes, **kw):
+    kw.setdefault("ping_interval_s", 0.05)
+    kw.setdefault("ping_timeout_s", 1.0)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    kw.setdefault("retry_backoff_s", 0.01)
+    kw.setdefault("respawn_backoff_s", 0.05)
+    kw.setdefault("drain_timeout_s", 2.0)
+    r = Router(attach=[("127.0.0.1", f.port) for f in fakes],
+               state_dir=str(tmp_path / "router"), **kw)
+    return r.start(timeout_s=10.0)
+
+
+def _wait(cond, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- import purity -----------------------------------------------------------
+def test_router_module_is_stdlib_only():
+    """The supervisor-parent rule: importing the router (and the wire +
+    admission modules it rides on) must pull neither jax nor numpy —
+    the router has to outlive any backend wedge its replicas hit."""
+    code = ("import sys; import tpuic.serve.router; "
+            "bad = [m for m in ('jax', 'numpy', 'flax') "
+            "if m in sys.modules]; "
+            "assert not bad, f'router imported {bad}'; print('pure')")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "pure" in out.stdout
+
+
+# -- unit: retry budget ------------------------------------------------------
+def test_retry_budget_ratio_of_successes():
+    b = RetryBudget(ratio=0.5, cap=2.0)
+    assert b.try_retry() and b.try_retry()  # starts full (cold-start room)
+    assert not b.try_retry()                # dry
+    assert b.state()["denied"] == 1
+    for _ in range(2):
+        b.deposit()                         # 2 successes x 0.5 = 1 token
+    assert b.try_retry()
+    assert not b.try_retry()
+
+
+def test_retry_budget_cap_bounds_burst():
+    b = RetryBudget(ratio=1.0, cap=3.0)
+    for _ in range(100):
+        b.deposit()
+    assert b.state()["tokens"] == 3.0
+    assert all(b.try_retry() for _ in range(3))
+    assert not b.try_retry()
+
+
+# -- unit: circuit breaker ---------------------------------------------------
+def test_breaker_opens_on_consecutive_failures_and_probes():
+    now = [0.0]
+    seen = []
+    cb = CircuitBreaker(threshold=3, cooldown_s=1.0, clock=lambda: now[0],
+                        on_transition=lambda o, n, r: seen.append((o, n)))
+    assert cb.try_acquire()
+    cb.record_failure()
+    cb.record_failure()
+    cb.record_success()        # success resets the consecutive count
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == "closed"
+    cb.record_failure()        # third consecutive -> open
+    assert cb.state == "open"
+    assert not cb.try_acquire()            # cooling down
+    now[0] = 1.5
+    assert cb.try_acquire()                # half-open probe slot
+    assert cb.state == "half_open"
+    assert not cb.try_acquire()            # one probe at a time
+    cb.record_success()
+    assert cb.state == "closed"
+    assert ("closed", "open") in seen and ("open", "half_open") in seen \
+        and ("half_open", "closed") in seen
+
+
+def test_breaker_probe_failure_reopens_and_trip_is_immediate():
+    now = [0.0]
+    cb = CircuitBreaker(threshold=3, cooldown_s=0.5, clock=lambda: now[0])
+    cb.trip("connection lost")             # conclusive: open NOW
+    assert cb.state == "open"
+    now[0] = 1.0
+    assert cb.try_acquire()
+    cb.record_failure("probe died")
+    assert cb.state == "open"              # re-opened, fresh cooldown
+    assert not cb.try_acquire()
+    now[0] = 2.0
+    assert cb.try_acquire()
+    cb.record_success()
+    assert cb.state == "closed"
+
+
+# -- routing -----------------------------------------------------------------
+def test_routes_and_resolves_responses(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    r = _router(tmp_path, fakes)
+    try:
+        futs = [r.submit(line={"path": f"img{i}.png"}, timeout=5,
+                         client_id=f"c{i}") for i in range(8)]
+        for i, f in enumerate(futs):
+            rec = f.result(timeout=10)
+            assert rec["pred"] == "0" and rec["id"] == f"c{i}"
+            assert rec["replica"] in ("r0", "r1")
+        snap = r.stats.snapshot()
+        assert snap["offered"] == 8 and snap["requests"] == 8
+        assert snap["rejected"] == 0 and snap["errors"] == 0
+        # least-loaded + routed tiebreak spread the work across both
+        assert all(rep["routed"] > 0
+                   for rep in snap["replicas"].values())
+    finally:
+        r.close(drain=False)
+        for f in fakes:
+            f.kill()
+
+
+def test_typed_replica_verdicts_cross_the_wire(tmp_path):
+    """An engine-side typed rejection (here: deadline) crosses the
+    socket and resolves the client future as the SAME exception type a
+    local engine would raise — wire.rebuild_error round trip."""
+    def shed(req):
+        return wire.error_record(
+            req["id"], DeadlineExceeded("deadline expired before "
+                                        "service", priority="low"))
+    fakes = [FakeReplica(respond=shed)]
+    r = _router(tmp_path, fakes)
+    try:
+        fut = r.submit(line={"path": "x.png", "priority": "low"},
+                       timeout=5)
+        with pytest.raises(DeadlineExceeded) as ei:
+            fut.result(timeout=10)
+        assert ei.value.cause == "deadline"
+        snap = r.stats.snapshot()
+        assert snap["rejected_by"] == {"deadline": {"low": 1}}
+        assert snap["requests"] == 0 and snap["offered"] == 1
+    finally:
+        r.close(drain=False)
+        fakes[0].kill()
+
+
+def test_spill_limit_sheds_typed_when_fleet_saturated(tmp_path):
+    """Shed-aware routing: with every replica at its spill limit the
+    router sheds with a typed queue_full verdict instead of queueing
+    toward a timeout (the ROADMAP's 'sheds instead of timing out')."""
+    fakes = [FakeReplica(hold=True), FakeReplica(hold=True)]
+    r = _router(tmp_path, fakes, spill_inflight=1)
+    try:
+        held = [r.submit(line={"path": "a"}, timeout=5) for _ in range(2)]
+        _wait(lambda: sum(len(f.held) for f in fakes) == 2,
+              msg="both replicas holding one request")
+        with pytest.raises(AdmissionRejected) as ei:
+            r.submit(line={"path": "c"}, timeout=0).result(timeout=5)
+        assert ei.value.cause == "queue_full"
+        assert "spill limit" in str(ei.value)
+        snap = r.stats.snapshot()
+        assert snap["rejected_by"]["queue_full"]["normal"] == 1
+        for f in held:
+            assert not f.done()  # the held ones are untouched
+    finally:
+        r.close(drain=False)
+        for f in fakes:
+            f.kill()
+
+
+# -- failover ----------------------------------------------------------------
+def test_kill_mid_flight_fails_over_to_survivor(tmp_path):
+    """THE tentpole contract in miniature: a replica dies with a
+    request in flight; the request requeues to the survivor under the
+    retry budget and resolves — zero client timeouts — while the dead
+    replica's breaker trips open; in-flight work elsewhere and the
+    ledger stay exact."""
+    victim, survivor = FakeReplica(hold=True), FakeReplica()
+    r = _router(tmp_path, [victim, survivor])
+    try:
+        fut = r.submit(line={"path": "v.png"}, timeout=5, client_id="v")
+        _wait(lambda: len(victim.held) == 1, msg="victim holding")
+        victim.kill()
+        rec = fut.result(timeout=10)      # failover, not a timeout
+        assert rec["id"] == "v" and rec["replica"] == "r1"
+        assert fut.tpuic_retries == 1     # the loadgen on_retry contract
+        snap = r.stats.snapshot()
+        assert snap["requests"] == 1 and snap["offered"] == 1
+        assert snap["failovers"] == 1 and snap["retries"] == 1
+        assert snap["failover_requeued"] == 1
+        assert snap["replicas"]["r0"]["state"] == "down"
+        assert snap["replicas"]["r0"]["breaker"]["state"] == "open"
+        # the failover + breaker trail landed in the ledger
+        events = [json.loads(ln) for ln in
+                  open(r.ledger_path).read().splitlines()]
+        kinds = [e["event"] for e in events]
+        assert "router_failover" in kinds and "router_retry" in kinds
+        breaker = [e for e in events if e["event"] == "router_breaker"
+                   and e["replica"] == "r0"]
+        assert any(e["new"] == "open" for e in breaker)
+    finally:
+        r.close(drain=False)
+        survivor.kill()
+
+
+def test_non_idempotent_request_gets_replica_lost(tmp_path):
+    victim = FakeReplica(hold=True)
+    survivor = FakeReplica()
+    r = _router(tmp_path, [victim, survivor])
+    try:
+        fut = r.submit(line={"path": "v.png"}, timeout=5,
+                       idempotent=False)
+        _wait(lambda: len(victim.held) == 1, msg="victim holding")
+        victim.kill()
+        with pytest.raises(ReplicaLost) as ei:
+            fut.result(timeout=10)
+        assert ei.value.cause == "replica_lost"
+        assert "not idempotent" in str(ei.value)
+        snap = r.stats.snapshot()
+        assert snap["rejected_by"]["replica_lost"]["normal"] == 1
+        assert snap["failover_lost"] == 1 and snap["retries"] == 0
+        assert len(survivor.seen) == 0  # never replayed
+    finally:
+        r.close(drain=False)
+        survivor.kill()
+
+
+def test_dry_retry_budget_sheds_instead_of_storming(tmp_path):
+    """No retry storms: with the budget dry, a replica loss resolves
+    its in-flight as replica_lost instead of replaying."""
+    victim = FakeReplica(hold=True)
+    survivor = FakeReplica()
+    r = _router(tmp_path, [victim, survivor],
+                retry_ratio=0.0, retry_cap=1.0)  # exactly one token, ever
+    try:
+        futs = [r.submit(line={"path": f"{i}.png"}, timeout=5)
+                for i in range(3)]
+        _wait(lambda: len(victim.held) >= 1, msg="victim holding")
+        time.sleep(0.2)  # let routing settle (some land on survivor)
+        n_victim = len(victim.held)
+        victim.kill()
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(timeout=10)
+                outcomes.append("ok")
+            except ReplicaLost:
+                outcomes.append("lost")
+        snap = r.stats.snapshot()
+        # every request resolved exactly once; at most ONE replay spent
+        assert snap["requests"] + snap["rejected"] == 3
+        assert snap["retries"] <= 1
+        if n_victim >= 2:
+            assert outcomes.count("lost") == n_victim - 1
+            assert snap["rejected_by"]["replica_lost"]["normal"] \
+                == n_victim - 1
+    finally:
+        r.close(drain=False)
+        survivor.kill()
+
+
+def test_breaker_half_open_rejoins_restarted_replica(tmp_path):
+    """The rejoin path the soak asserts: kill -> breaker open ->
+    replica comes back on the same address -> reconnect -> half-open
+    probe -> closed, and traffic flows to it again."""
+    victim, survivor = FakeReplica(), FakeReplica()
+    r = _router(tmp_path, [victim, survivor], breaker_cooldown_s=0.1)
+    try:
+        assert r.submit(line={"path": "warm"},
+                        timeout=5).result(10)["pred"] == "0"
+        port = victim.port
+        victim.kill()
+        _wait(lambda: (r.replicas[0].state == "down"
+                       and r.replicas[0].breaker.state == "open"),
+              msg="victim down with breaker open")
+        # requests keep flowing to the survivor meanwhile
+        assert r.submit(line={"path": "mid"},
+                        timeout=5).result(10)["replica"] == "r1"
+        reborn = FakeReplica(port=port)     # same address, new process
+        _wait(lambda: r.replicas[0].state == "up", msg="reconnect")
+        # route until the half-open probe lands on r0 and closes it
+        _wait(lambda: (any(r.submit(line={"path": "p"}, timeout=5)
+                           .result(10) is not None for _ in [0])
+                       and r.replicas[0].breaker.state == "closed"),
+              timeout=10.0, msg="half-open probe to close")
+        events = [json.loads(ln) for ln in
+                  open(r.ledger_path).read().splitlines()
+                  if '"router_breaker"' in ln]
+        states = [e["new"] for e in events if e["replica"] == "r0"]
+        assert "open" in states and "half_open" in states \
+            and "closed" in states
+        i_open = states.index("open")
+        assert states.index("half_open", i_open) < states.index(
+            "closed", i_open)  # open -> half_open -> closed, in order
+        reborn.kill()
+    finally:
+        r.close(drain=False)
+        survivor.kill()
+
+
+# -- drain -------------------------------------------------------------------
+def test_drain_sheds_new_and_resolves_stragglers_typed(tmp_path):
+    holder = FakeReplica(hold=True)
+    r = _router(tmp_path, [holder])
+    try:
+        fut = r.submit(line={"path": "stuck"}, timeout=5)
+        _wait(lambda: len(holder.held) == 1, msg="held")
+        stragglers = r.drain(timeout_s=0.3)
+        assert stragglers == 1
+        with pytest.raises(ReplicaLost, match="drain timeout"):
+            fut.result(timeout=5)
+        with pytest.raises(AdmissionRejected, match="draining"):
+            r.submit(line={"path": "late"}, timeout=0).result(timeout=5)
+        snap = r.stats.snapshot()
+        assert snap["requests"] == 0
+        assert snap["rejected"] == 2 == snap["offered"]
+    finally:
+        r.close(drain=False)
+        holder.kill()
+
+
+# -- loadgen endpoint protocol ----------------------------------------------
+def test_run_stream_drives_router_with_on_retry_hook(tmp_path):
+    """The one-harness pledge: loadgen.run_stream drives a Router like
+    an engine — same ledger contract, and the on_retry outcome hook
+    reports failover replays."""
+    from tpuic.serve.loadgen import run_stream
+
+    victim, survivor = FakeReplica(hold=True), FakeReplica()
+    r = _router(tmp_path, [victim, survivor])
+    try:
+        retries, done = [], []
+        items = [{"path": f"{i}.png"} for i in range(10)]
+
+        def kill_late():
+            _wait(lambda: len(victim.held) >= 1, msg="victim holding")
+            victim.kill()
+
+        killer = threading.Thread(target=kill_late, daemon=True)
+        killer.start()
+        wall, arrival, snap = run_stream(
+            r, items, offsets_s=[0.03 * i for i in range(10)],
+            result_timeout_s=30.0,
+            on_done=lambda i, ok, s: done.append((i, ok)),
+            on_retry=lambda i, n: retries.append((i, n)))
+        killer.join(timeout=5)
+        assert len(done) == 10
+        assert snap["requests"] + snap["rejected"] == 10  # exact ledger
+        assert snap["offered"] == 10
+        if snap["retries"]:
+            assert retries  # replays surfaced through the hook
+            assert all(n >= 1 for _, n in retries)
+    finally:
+        r.close(drain=False)
+        survivor.kill()
+
+
+# -- wire --------------------------------------------------------------------
+def test_wire_error_lines_identical_across_tiers():
+    """The satellite contract: one encoder, one shape — an
+    AdmissionError renders the same {id,error,cause,priority} record
+    whether the accept path, drain(), or the router emits it."""
+    exc = AdmissionRejected("queue full (priority=low)",
+                            cause="queue_full", priority="low")
+    rec = json.loads(wire.error_line("r1", exc))
+    assert rec == {"id": "r1", "error": "queue full (priority=low)",
+                   "cause": "queue_full", "priority": "low"}
+    # untyped errors carry no cause fields
+    rec = json.loads(wire.error_line("r2", "decode: boom"))
+    assert rec == {"id": "r2", "error": "decode: boom"}
+    # id-less (malformed line) records omit the id
+    assert "id" not in json.loads(wire.error_line(None, "bad line"))
+
+
+def test_wire_rebuild_error_round_trip():
+    for exc in (AdmissionRejected("q", cause="brownout", priority="low"),
+                DeadlineExceeded("d", priority="high"),
+                ReplicaLost("r", priority="normal")):
+        back = wire.rebuild_error(wire.error_record("x", exc))
+        assert type(back) is type(exc)
+        assert isinstance(back, AdmissionError)
+        assert back.cause == exc.cause and back.priority == exc.priority
+    assert isinstance(wire.rebuild_error({"error": "plain"}),
+                      RuntimeError)
+
+
+def test_wire_array_round_trip():
+    np = pytest.importorskip("numpy")
+    arr = np.arange(2 * 4 * 4 * 3, dtype=np.uint8).reshape(2, 4, 4, 3)
+    rec = wire.encode_array(arr)
+    assert set(rec) == {"b64", "shape", "dtype"}
+    back = wire.decode_array(rec)
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    assert (back == arr).all()
+    with pytest.raises(ValueError, match="bad array payload"):
+        wire.decode_array({"b64": "!!!", "shape": [1]})

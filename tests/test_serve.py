@@ -399,6 +399,154 @@ def test_serve_span_total_matches_measured_latency():
         assert wall - total < 250.0         # and not wildly below it
 
 
+# -- socket-JSONL transport (the replica side of the router) -----------------
+class _FakeGuard:
+    """Duck-typed PreemptionGuard for driving serve_socket inline."""
+
+    def __init__(self):
+        self.triggered = False
+
+
+def _probs_forward(variables, images):
+    """Stub forward in the engine's (probs, order) result shape."""
+    s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+    probs = jax.nn.softmax(
+        jnp.stack([s, -s, jnp.zeros_like(s)], axis=-1), axis=-1)
+    return probs, jnp.argsort(-probs, axis=-1)
+
+
+def _socket_server(tmp_path, **engine_kw):
+    """A live serve_socket around a stub engine, on a background
+    thread; returns (engine, guard, ready, stop)."""
+    import threading
+
+    from tpuic.serve import wire
+    from tpuic.serve.__main__ import serve_socket
+
+    engine_kw.setdefault("forward_fn", _probs_forward)
+    engine_kw.setdefault("variables", {})
+    engine_kw.setdefault("image_size", SIZE)
+    engine_kw.setdefault("input_dtype", np.uint8)
+    engine_kw.setdefault("buckets", (1, 2, 4, 8))
+    engine_kw.setdefault("max_wait_ms", 2.0)
+    eng = InferenceEngine(**engine_kw)
+    eng.warmup()
+    guard = _FakeGuard()
+    ready_file = str(tmp_path / "ready.json")
+    names = {i: str(i) for i in range(3)}
+    t = threading.Thread(
+        target=serve_socket, daemon=True,
+        kwargs=dict(engine=eng, listen="127.0.0.1:0", names=names,
+                    top_k=2, size=SIZE, guard=guard, beat=lambda: None,
+                    drain_timeout=5.0, ready_file=ready_file,
+                    log=lambda msg: None))
+    t.start()
+    deadline = time.monotonic() + 10.0
+    ready = None
+    while time.monotonic() < deadline:
+        ready = wire.read_ready_file(ready_file)
+        if ready is not None:
+            break
+        time.sleep(0.01)
+    assert ready is not None, "socket server never wrote its ready file"
+
+    def stop():
+        guard.triggered = True
+        t.join(timeout=10.0)
+        eng.close()
+
+    return eng, guard, ready, stop
+
+
+def _sock_request(port, lines, n_responses, timeout=15.0):
+    """Send JSONL lines, read n responses (newline-framed records)."""
+    import socket as _socket
+
+    out, buf = [], b""
+    with _socket.create_connection(("127.0.0.1", port),
+                                   timeout=timeout) as sock:
+        for line in lines:
+            sock.sendall((json.dumps(line) + "\n").encode())
+        sock.settimeout(timeout)
+        while len(out) < n_responses:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                break
+            *recs, buf = (buf + chunk).split(b"\n")
+            out.extend(json.loads(r) for r in recs if r.strip())
+    return out
+
+
+def test_serve_socket_end_to_end(tmp_path):
+    """The replica transport: ready-file handshake (port + pid), b64
+    array requests answered by id, pings answered with queue depth,
+    malformed and undecodable requests getting typed-shape error lines
+    from the shared wire encoder — all on one connection."""
+    from tpuic.serve import wire
+
+    eng, guard, ready, stop = _socket_server(tmp_path)
+    try:
+        assert ready["pid"] == __import__("os").getpid()
+        port = ready["port"]
+        rng = np.random.default_rng(11)
+        img = rng.integers(0, 256, (1, SIZE, SIZE, 3), np.uint8)
+        recs = _sock_request(port, [
+            {"id": "a", **wire.encode_array(img)},
+            {"op": "ping", "id": "p1"},
+            {"id": "bad", "b64": "!!!", "shape": [1]},
+            {"id": "noimg"},
+            "not-an-object",
+        ], 5)
+        by_id = {r.get("id"): r for r in recs}
+        assert by_id["a"]["pred"] in {"0", "1", "2"}
+        assert len(by_id["a"]["topk"]) == 2
+        assert by_id["p1"]["op"] == "pong"
+        assert by_id["p1"]["queue_depth"] >= 0
+        assert by_id["bad"]["error"].startswith("decode:")
+        assert "needs 'path' or 'b64'" in by_id["noimg"]["error"]
+        assert "bad request line" in by_id[None]["error"]
+    finally:
+        stop()
+
+
+def test_serve_socket_sigterm_drains_with_typed_stragglers(tmp_path):
+    """The PR-2 preemption contract over the socket: requests accepted
+    before the latch drain to completion; a wedged straggler gets an
+    explicit error line, never a silent drop."""
+    from tpuic.runtime import faults
+
+    eng, guard, ready, stop = _socket_server(tmp_path)
+    try:
+        rng = np.random.default_rng(12)
+        from tpuic.serve import wire
+        img = rng.integers(0, 256, (1, SIZE, SIZE, 3), np.uint8)
+        recs = _sock_request(ready["port"],
+                             [{"id": f"d{i}", **wire.encode_array(img)}
+                              for i in range(4)], 4)
+        assert {r["id"] for r in recs} == {f"d{i}" for i in range(4)}
+        assert all("pred" in r for r in recs)
+    finally:
+        faults.reset()
+        stop()
+    import os
+    assert not os.path.exists(str(tmp_path / "ready.json")), \
+        "a stopped replica must remove its ready file"
+
+
+def test_replica_fault_points_registered():
+    """The replica_crash/replica_wedge fault points parse through the
+    TPUIC_FAULTS grammar (fired in a real subprocess by the router
+    soak; here we pin the registration so a typo'd chaos spec fails
+    loudly instead of silently never firing)."""
+    from tpuic.runtime.faults import REGISTERED_POINTS, FaultPlan
+
+    assert {"replica_crash", "replica_wedge"} <= REGISTERED_POINTS
+    plan = FaultPlan("replica_crash@3,replica_wedge@5#0.5")
+    assert not plan.fire("replica_crash", 2)
+    assert plan.fire("replica_crash", 3)
+    assert plan.param("replica_wedge") == 0.5
+
+
 def test_serve_span_tracing_adds_zero_syncs_zero_compiles():
     """The tracing contract (ISSUE 6 acceptance): publishing span
     ledgers is host-clock arithmetic — the compile counter stays flat

@@ -62,6 +62,19 @@ Registered points (site → meaning of ``step``):
                       partial-hang twin of ``rank_crash`` for the gang's
                       per-rank watchdog (rank-attributed SIGQUIT
                       escalation, then coordinated teardown).
+- ``replica_crash`` — serve socket transport (serve/__main__.py,
+                      ``--listen``): SIGKILL this replica process after
+                      accepting the Nth request (``step`` = the accept
+                      counter) — abrupt replica death mid-storm for the
+                      router's breaker + in-flight failover path
+                      (tpuic/serve/router.py, docs/serving.md "Replica
+                      routing and failover").
+- ``replica_wedge`` — serve socket transport: stop servicing the socket
+                      at the Nth accepted request (sleep ``param``
+                      seconds; effectively forever without a payload) —
+                      pings go unanswered and the heartbeat goes stale,
+                      the shape the router's wedge watchdog escalates
+                      via the ``_Child`` SIGQUIT→TERM→KILL ladder.
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
@@ -103,7 +116,7 @@ __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
 REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
     "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
-    "rank_hang",
+    "rank_hang", "replica_crash", "replica_wedge",
 })
 
 

@@ -362,9 +362,13 @@ class _Child:
         self.spawned_at = 0.0
         self.last_change = 0.0
 
-    def spawn(self, env: Dict[str, str]) -> subprocess.Popen:
+    def spawn(self, env: Dict[str, str], stdout=None,
+              stderr=None) -> subprocess.Popen:
         """Start the process with the artifact env injected. Heartbeat
-        freshness is per-attempt: any stale file is removed first."""
+        freshness is per-attempt: any stale file is removed first.
+        ``stdout``/``stderr`` pass through to Popen (the router redirects
+        each replica's streams to per-replica log files — the
+        failure artifacts CI uploads); None inherits, as before."""
         try:
             os.remove(self.heartbeat_file)
         except OSError:
@@ -376,8 +380,10 @@ class _Child:
         self.hung = False
         self.first_step = self.last_step = None
         self.last_beats = -1
+        self._term_pid = None
         self.spawned_at = self.last_change = time.monotonic()
-        self.proc = subprocess.Popen(self.cmd, env=env)
+        self.proc = subprocess.Popen(self.cmd, env=env, stdout=stdout,
+                                     stderr=stderr)
         return self.proc
 
     @property

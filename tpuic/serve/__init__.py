@@ -1,4 +1,4 @@
-"""tpuic.serve — dynamic-batching AOT inference engine.
+"""tpuic.serve — dynamic-batching AOT inference engine + replica router.
 
 Online serving counterpart of the training loop's saturate-the-chip
 design: a bounded request queue + micro-batcher coalesces caller
@@ -13,14 +13,55 @@ latency/throughput accounting.
     fut = eng.submit(images_u8)       # [n,S,S,3] -> Future
     probs, order = fut.result()
 
-``python -m tpuic.serve`` runs the stdin-JSONL / directory-watch driver
-(tpuic/serve/__main__.py) — no network dependency.
+``python -m tpuic.serve`` runs the stdin-JSONL / directory-watch /
+socket-JSONL driver (tpuic/serve/__main__.py); ``python -m
+tpuic.serve.router`` runs N such replicas behind a health-checked,
+breaker-guarded front tier (tpuic/serve/router.py, docs/serving.md
+"Replica routing and failover").
+
+Re-exports resolve lazily (PEP 562, the tpuic/__init__.py idiom): the
+router and the admission/wire modules are stdlib-only, and importing
+this package from the router process must not pull the engine's
+numpy/jax stack into a parent that has to outlive a backend wedge.
 """
 
-from tpuic.serve.admission import (PRIORITIES, AdmissionController,  # noqa: F401
-                                   AdmissionError, AdmissionRejected,
-                                   BrownoutController, DeadlineExceeded,
-                                   TokenBucket, parse_quotas)
-from tpuic.serve.engine import (DEFAULT_BUCKETS, InferenceEngine,  # noqa: F401
-                                default_buckets, make_forward)
-from tpuic.serve.metrics import ServeStats  # noqa: F401
+from __future__ import annotations
+
+_LAZY = {
+    # admission (stdlib-only module)
+    "PRIORITIES": ("tpuic.serve.admission", "PRIORITIES"),
+    "AdmissionController": ("tpuic.serve.admission", "AdmissionController"),
+    "AdmissionError": ("tpuic.serve.admission", "AdmissionError"),
+    "AdmissionRejected": ("tpuic.serve.admission", "AdmissionRejected"),
+    "BrownoutController": ("tpuic.serve.admission", "BrownoutController"),
+    "DeadlineExceeded": ("tpuic.serve.admission", "DeadlineExceeded"),
+    "ReplicaLost": ("tpuic.serve.admission", "ReplicaLost"),
+    "TokenBucket": ("tpuic.serve.admission", "TokenBucket"),
+    "parse_quotas": ("tpuic.serve.admission", "parse_quotas"),
+    # engine (numpy + lazy jax)
+    "DEFAULT_BUCKETS": ("tpuic.serve.engine", "DEFAULT_BUCKETS"),
+    "InferenceEngine": ("tpuic.serve.engine", "InferenceEngine"),
+    "default_buckets": ("tpuic.serve.engine", "default_buckets"),
+    "make_forward": ("tpuic.serve.engine", "make_forward"),
+    # metrics
+    "ServeStats": ("tpuic.serve.metrics", "ServeStats"),
+    # router (stdlib-only module)
+    "Router": ("tpuic.serve.router", "Router"),
+    "RouterStats": ("tpuic.serve.router", "RouterStats"),
+    "CircuitBreaker": ("tpuic.serve.router", "CircuitBreaker"),
+    "RetryBudget": ("tpuic.serve.router", "RetryBudget"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: next access skips the import
+        return value
+    raise AttributeError(f"module 'tpuic.serve' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

@@ -1,6 +1,6 @@
-"""``python -m tpuic.serve`` — online inference driver, no network needed.
+"""``python -m tpuic.serve`` — online inference driver.
 
-Two request sources, both feeding the same InferenceEngine:
+Three request sources, all feeding the same InferenceEngine:
 
 - **stdin JSONL** (default): one request per line,
   ``{"id": "r1", "path": "img.png"}`` (``id`` optional, defaults to the
@@ -9,6 +9,14 @@ Two request sources, both feeding the same InferenceEngine:
 - **directory watch** (``--watch DIR``): polls DIR for new image files
   and classifies each once; ``--once`` processes the current contents
   and exits (the tier-1-testable mode).
+- **socket JSONL** (``--listen HOST:PORT``): the replica transport the
+  router (``python -m tpuic.serve.router``, docs/serving.md "Replica
+  routing and failover") drives.  Same request lines as stdin plus a
+  ``{"b64", "shape", "dtype"}`` raw-array payload (tpuic/serve/wire.py)
+  and a ``{"op": "ping"}`` liveness probe answered with queue depth;
+  responses go back on the requesting connection, keyed by id.
+  ``--ready-file`` atomically publishes the bound port + pid once the
+  engine is warmed — the router's port-handoff channel.
 
 Decode (PIL) of request N+1 overlaps the device call for batch N: the
 driver only *submits* work and drains completed futures opportunistically
@@ -41,6 +49,8 @@ from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as np
 
+from tpuic.runtime import faults as _faults  # stdlib-only import
+from tpuic.serve import wire  # stdlib-only import
 from tpuic.serve.admission import AdmissionError  # stdlib-only import
 
 
@@ -77,6 +87,238 @@ def _class_names(ckpt_dir: str, model: str, num_classes: int,
     return names
 
 
+def _result_record(rid, probs, order, names, k: int) -> dict:
+    """One response record: ``{"id", "pred", "prob", "topk"}`` — the
+    shape every transport (stdin, watch, socket) emits."""
+    topk = [[names.get(int(order[0, j]), str(int(order[0, j]))),
+             round(float(probs[0, order[0, j]]), 6)]
+            for j in range(k)]
+    return {"id": rid, "pred": topk[0][0], "prob": topk[0][1],
+            "topk": topk}
+
+
+def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
+                 guard, beat, drain_timeout: float = 30.0,
+                 ready_file: str = "", prom_port=None,
+                 log=lambda msg: print(msg, file=sys.stderr)) -> int:
+    """The socket-JSONL replica transport (docs/serving.md, "Replica
+    routing and failover").
+
+    Accepts connections on ``listen`` (HOST:PORT, port 0 = kernel
+    assigned) and speaks newline-delimited JSON per connection:
+
+    - request lines as in stdin mode (``path`` or a ``b64`` raw-array
+      payload, optional SLA fields honored under --admission), answered
+      on the SAME connection with the usual result record or a typed
+      error line (wire.py — identical shape to the stdin tier's);
+      responses are keyed by id and may arrive out of submission order
+      (a deadline shed resolves before its batchmates).
+    - ``{"op": "ping", "id": ...}`` -> ``{"op": "pong", "id",
+      "queue_depth", "inflight", "pid"}`` — the router's live probe.
+
+    Single-threaded select loop (the stdin design, multiplexed): reads
+    submit, completed futures flush opportunistically each tick, and
+    the SIGTERM latch drains everything in flight for up to
+    ``drain_timeout`` seconds with typed straggler lines — the PR-2
+    preemption contract, per connection.
+
+    ``ready_file`` is written (atomic, wire.py) once the socket is
+    bound — and the engine is already warmed by then — so the router's
+    spawn handshake never races warmup.
+
+    Fault points (runtime/faults.py): ``replica_crash`` SIGKILLs this
+    process at the Nth accepted request; ``replica_wedge`` stops
+    servicing the socket there (pings included) so the heartbeat goes
+    stale — the two replica-death shapes the router must survive.
+    """
+    import select
+    import signal as _signal
+    import socket as _socket
+
+    host, port = wire.parse_hostport(listen)
+    srv = _socket.create_server((host, port), backlog=64)
+    srv.setblocking(False)
+    bound = srv.getsockname()[1]
+    if ready_file:
+        wire.write_ready_file(ready_file, port=int(bound), pid=os.getpid(),
+                              prom_port=prom_port)
+    log(f"[serve] socket-JSONL transport on {host}:{bound}"
+        + (f" (ready file {ready_file})" if ready_file else ""))
+
+    conns: dict = {}  # socket -> {"buf": bytes, "pending": deque}
+    served = 0
+    accepted = 0  # request counter: the fault points' step axis
+
+    def close_conn(sock) -> None:
+        st = conns.pop(sock, None)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if st is None:
+            return
+        for _, fut in st["pending"]:
+            # Client gone: nothing to deliver to. Swallow the outcome
+            # so an abandoned future never logs "exception never
+            # retrieved" noise.
+            fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+
+    def send(sock, rec: dict) -> None:
+        try:
+            sock.sendall((json.dumps(rec) + "\n").encode())
+        except OSError:
+            close_conn(sock)
+
+    def handle_line(sock, st, raw: str) -> None:
+        nonlocal accepted
+        try:
+            req = json.loads(raw)
+            if not isinstance(req, dict):
+                raise ValueError("not an object")
+        except ValueError:
+            send(sock, wire.error_record(
+                None, f"bad request line: {raw[:80]}"))
+            return
+        if req.get("op") == "ping":
+            send(sock, {"id": req.get("id"), "op": "pong",
+                        "queue_depth": engine.queue_depth(),
+                        "inflight": sum(len(s["pending"])
+                                        for s in conns.values()),
+                        "pid": os.getpid()})
+            return
+        accepted += 1
+        if _faults.fire("replica_crash", accepted):
+            os.kill(os.getpid(), _signal.SIGKILL)
+        if _faults.fire("replica_wedge", accepted):
+            w = _faults.param("replica_wedge")
+            time.sleep(3600.0 if w is None else float(w))  # tpuic-ok: TPU101 fault param is a host float
+        rid = str(req.get("id", req.get("path", accepted)))
+        try:
+            if req.get("b64") is not None:
+                img = wire.decode_array(req)
+            elif req.get("path") is not None:
+                img = _load_image(str(req["path"]), size)
+            else:
+                raise ValueError("request needs 'path' or 'b64'")
+        except Exception as e:  # noqa: BLE001
+            send(sock, wire.error_record(rid, f"decode: {e}"))
+            return
+        sla = {}
+        if engine.admission is not None:
+            sla = {f: req[f] for f in ("priority", "deadline_ms", "tenant")
+                   if req.get(f) is not None}
+            sla.setdefault("timeout", 0)
+        try:
+            st["pending"].append((rid, engine.submit(img, **sla)))
+        except (AdmissionError, ValueError, TypeError) as e:
+            send(sock, wire.error_record(rid, e))
+
+    def flush(sock, st) -> None:
+        """Emit every completed future on this connection (any order —
+        responses are keyed by id, and a shed must not wait behind the
+        batch ahead of it)."""
+        nonlocal served
+        still = deque()
+        while st["pending"]:
+            rid, fut = st["pending"].popleft()
+            if not fut.done():
+                still.append((rid, fut))
+                continue
+            if fut.cancelled():
+                send(sock, wire.error_record(rid, "cancelled"))
+            elif fut.exception() is not None:
+                send(sock, wire.error_record(rid, fut.exception()))
+            else:
+                probs, order = fut.result()
+                send(sock, _result_record(rid, probs, order, names,
+                                          top_k))
+                served += 1
+            if sock not in conns:
+                # send() failed and close_conn ran: it swallowed what
+                # was left on the ORPHANED state dict, but the entries
+                # already moved to `still` need the same treatment —
+                # re-attaching them would strand futures nobody flushes.
+                for _, f in still:
+                    f.add_done_callback(
+                        lambda fu: fu.cancelled() or fu.exception())
+                return
+        st["pending"] = still
+
+    try:
+        while not guard.triggered:
+            busy = any(s["pending"] for s in conns.values())
+            try:
+                ready, _, _ = select.select([srv] + list(conns), [], [],
+                                            0.005 if busy else 0.1)
+            except (OSError, ValueError):
+                break
+            for sock in ready:
+                if sock is srv:
+                    try:
+                        c, _ = srv.accept()
+                        c.setblocking(True)
+                        c.settimeout(5.0)  # a stalled peer must not wedge sendall
+                        conns[c] = {"buf": b"", "pending": deque()}
+                    except OSError:
+                        pass
+                    continue
+                st = conns.get(sock)
+                if st is None:
+                    continue
+                try:
+                    chunk = sock.recv(1 << 16)
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    close_conn(sock)  # peer EOF
+                    continue
+                *lines, st["buf"] = (st["buf"] + chunk).split(b"\n")
+                for raw in lines:
+                    if raw.strip():
+                        handle_line(sock, st, raw.decode("utf-8", "replace"))
+            for sock in list(conns):
+                if sock in conns:
+                    flush(sock, conns[sock])
+            beat()
+        # SIGTERM drain (the PR-2 preemption contract): stop accepting,
+        # flush in-flight for the grace window, typed straggler lines.
+        n_pending = sum(len(s["pending"]) for s in conns.values())
+        if guard.triggered and n_pending:
+            log(f"[serve] SIGTERM: draining {n_pending} in-flight "
+                f"socket request(s) (timeout {drain_timeout:.1f}s)")
+            deadline = time.monotonic() + max(0.0, drain_timeout)
+            while (any(s["pending"] for s in conns.values())
+                   and time.monotonic() < deadline):
+                for sock in list(conns):
+                    if sock in conns:
+                        flush(sock, conns[sock])
+                time.sleep(0.02)
+            for sock in list(conns):
+                st = conns.get(sock)
+                if st is None:
+                    continue
+                flush(sock, st)
+                for rid, fut in st["pending"]:
+                    fut.cancel()
+                    send(sock, wire.error_record(
+                        rid, "drain timeout: engine shutting down "
+                        "before this request finished"))
+                st["pending"] = deque()
+    finally:
+        for sock in list(conns):
+            close_conn(sock)
+        try:
+            srv.close()
+        except OSError:
+            pass
+        if ready_file:
+            try:
+                os.remove(ready_file)  # a dead replica must not look ready
+            except OSError:
+                pass
+    return served
+
+
 def build_engine(args):
     """Checkpoint -> warmed InferenceEngine (shared predict loading rules)."""
     if args.compile_cache_dir:
@@ -96,6 +338,35 @@ def build_engine(args):
                               RunConfig)
     from tpuic.predict import resolve_model_auto
     from tpuic.serve import InferenceEngine
+
+    if args.synthetic_init:
+        # Seeded random init, no checkpoint: the load-testing / router-
+        # soak replica mode.  Every replica built from the same seed
+        # carries IDENTICAL weights, so a failover replay on a survivor
+        # returns the same prediction the dead replica would have.
+        import jax
+        import jax.numpy as jnp
+
+        from tpuic.models import create_model
+        if args.model == "auto" or args.num_classes <= 0:
+            raise SystemExit("serve: --synthetic-init needs an explicit "
+                             "--model and --num-classes (there is no "
+                             "checkpoint to resolve them from)")
+        resize = args.resize if args.resize is not None else 299
+        model = create_model(args.model, args.num_classes, dtype="float32")
+        variables = model.init(
+            jax.random.key(0),
+            jnp.zeros((1, resize, resize, 3), jnp.float32), train=False)
+        dc = DataConfig(data_dir=".", resize_size=resize)
+        engine = InferenceEngine(
+            model, variables, image_size=resize, input_dtype=np.uint8,
+            normalize=True, mean=dc.mean, std=dc.std,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            max_wait_ms=args.max_wait_ms, queue_size=args.queue_size)
+        t = engine.warmup()
+        print(f"[serve] synthetic init ({args.model}); warmup compiled "
+              f"{len(t)} bucket executables: {t}", file=sys.stderr)
+        return engine, resize, args.num_classes, args.model
 
     model_name, num_classes, resize = args.model, args.num_classes, args.resize
     ema_decay = 0.0
@@ -177,6 +448,19 @@ def main(argv=None) -> int:
     p.add_argument("--poll-s", type=float, default=0.5)
     p.add_argument("--once", action="store_true",
                    help="with --watch: process current files, then exit")
+    p.add_argument("--listen", default="",
+                   help="serve socket JSONL on HOST:PORT instead of "
+                        "stdin (port 0 = kernel-assigned; the replica "
+                        "transport behind python -m tpuic.serve.router)")
+    p.add_argument("--ready-file", default="",
+                   help="with --listen: atomically write {port, pid, "
+                        "prom_port} here once the engine is warmed and "
+                        "the socket is bound — the router's port "
+                        "handoff")
+    p.add_argument("--synthetic-init", action="store_true",
+                   help="seeded random init instead of a checkpoint "
+                        "(load testing / router-soak replicas; requires "
+                        "explicit --model and --num-classes)")
     p.add_argument("--out", default="", help="output JSONL (default stdout)")
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="on SIGTERM/SIGINT, wait up to this many seconds "
@@ -185,7 +469,11 @@ def main(argv=None) -> int:
     p.add_argument("--prom-port", type=int, default=0,
                    help="serve a Prometheus /metrics endpoint on this "
                         "port (queue wait, pad efficiency, latency "
-                        "percentiles from the shared meter; 0 disables)")
+                        "percentiles from the shared meter; 0 disables; "
+                        "-1 binds a kernel-assigned free port — the "
+                        "resolved port lands in --ready-file, how "
+                        "router replicas expose their health signals "
+                        "without port races)")
     p.add_argument("--prom-host", default="127.0.0.1",
                    help="interface for --prom-port (loopback by default "
                         "— the endpoint is unauthenticated; bind "
@@ -370,7 +658,7 @@ def main(argv=None) -> int:
 
     prom_server = None
     if args.prom_port:
-        prom_server = PromServer(args.prom_port, _prom_text,
+        prom_server = PromServer(max(0, args.prom_port), _prom_text,
                                  host=args.prom_host)
         print(f"[serve] prometheus /metrics on "
               f"{args.prom_host}:{prom_server.port}", file=sys.stderr)
@@ -380,7 +668,6 @@ def main(argv=None) -> int:
     # the admission layer's shedding can be driven (and CI-soaked)
     # without an external load generator.  Storm futures retrieve their
     # own outcomes: sheds and rejections are the point, not log spam.
-    from tpuic.runtime import faults as _faults
     import threading as _threading
     flood_stop = _threading.Event()
     if _faults.fire("flood"):
@@ -412,11 +699,8 @@ def main(argv=None) -> int:
 
     def emit(rid, probs, order) -> None:
         nonlocal served
-        topk = [[names.get(int(order[0, j]), str(int(order[0, j]))),
-                 round(float(probs[0, order[0, j]]), 6)]
-                for j in range(k)]
-        out.write(json.dumps({"id": rid, "pred": topk[0][0],
-                              "prob": topk[0][1], "topk": topk}) + "\n")
+        out.write(json.dumps(_result_record(rid, probs, order,
+                                            names, k)) + "\n")
         out.flush()
         served += 1
 
@@ -460,27 +744,22 @@ def main(argv=None) -> int:
                         try:
                             p, o = sfut.result()
                         except Exception as e:  # noqa: BLE001
-                            out.write(json.dumps(
-                                {"id": srid, "error": str(e)}) + "\n")
+                            out.write(wire.error_line(srid, e))
                         else:
                             emit(srid, p, o)
                         continue
                     sfut.cancel()  # not-yet-dispatched may still cancel
-                    out.write(json.dumps({
-                        "id": srid, "error": "drain timeout: engine "
-                        "shutting down before this request finished"}) + "\n")
+                    out.write(wire.error_line(
+                        srid, "drain timeout: engine shutting down "
+                        "before this request finished"))
                 out.flush()
                 return
             except Exception as e:  # noqa: BLE001 — per-request error line
-                rec = {"id": rid, "error": str(e)}
-                if isinstance(e, AdmissionError):
-                    # Typed verdict (a pop-time DeadlineExceeded shed,
-                    # or an eviction): name the cause + class so the
-                    # response stream carries the same labels the
-                    # rejected_total counter does.
-                    rec["cause"] = e.cause
-                    rec["priority"] = e.priority
-                out.write(json.dumps(rec) + "\n")
+                # wire.error_line types the verdict (a pop-time
+                # DeadlineExceeded shed, an eviction): cause + class
+                # labels match the rejected_total counter — the one
+                # encoder all three serve tiers share (wire.py).
+                out.write(wire.error_line(rid, e))
                 out.flush()
                 continue
             except BaseException:
@@ -502,7 +781,7 @@ def main(argv=None) -> int:
         try:
             img = _load_image(path, size)
         except Exception as e:  # noqa: BLE001
-            out.write(json.dumps({"id": rid, "error": f"decode: {e}"}) + "\n")
+            out.write(wire.error_line(rid, f"decode: {e}"))
             out.flush()
             return False
         try:
@@ -510,22 +789,29 @@ def main(argv=None) -> int:
                 sla.setdefault("timeout", 0)
             pending.append((rid, engine.submit(img, **sla)))
         except AdmissionError as e:
-            out.write(json.dumps({"id": rid, "error": str(e),
-                                  "cause": e.cause,
-                                  "priority": e.priority}) + "\n")
+            out.write(wire.error_line(rid, e))
             out.flush()
             return True  # the request was handled: verdict delivered
         except (ValueError, TypeError) as e:
             # Bad SLA fields (unknown priority, non-numeric deadline)
             # are the request's problem, not the server's.
-            out.write(json.dumps({"id": rid, "error": str(e)}) + "\n")
+            out.write(wire.error_line(rid, e))
             out.flush()
             return True
         drain(block=False)  # opportunistic: decode overlaps device work
         return True
 
     try:
-        if args.watch:
+        if args.listen:
+            served = serve_socket(
+                engine, listen=args.listen, names=names, top_k=k,
+                size=size, guard=guard, beat=_beat,
+                drain_timeout=args.drain_timeout,
+                ready_file=args.ready_file,
+                prom_port=(prom_server.port if prom_server is not None
+                           else None),
+                log=lambda msg: print(msg, file=sys.stderr))
+        elif args.watch:
             exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
             seen: set = set()
             attempts: dict = {}
@@ -574,8 +860,8 @@ def main(argv=None) -> int:
                     req = json.loads(line)
                     path = req["path"]
                 except (ValueError, KeyError, TypeError):
-                    out.write(json.dumps(
-                        {"error": f"bad request line: {line[:80]}"}) + "\n")
+                    out.write(wire.error_line(
+                        None, f"bad request line: {line[:80]}"))
                     out.flush()
                     return
                 # Optional SLA fields per request line — honored only

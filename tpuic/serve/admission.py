@@ -60,7 +60,11 @@ DEFAULT_PRIORITY = "normal"
 
 # The typed rejection vocabulary — exactly the causes the split
 # rejected_total counter and the prom rows are labeled with.
-CAUSES: Tuple[str, ...] = ("queue_full", "deadline", "quota", "brownout")
+# ``replica_lost`` is the routing tier's verdict (tpuic/serve/router.py):
+# the replica serving a request died and the request could not be safely
+# replayed (non-idempotent, retries exhausted, or the retry budget dry).
+CAUSES: Tuple[str, ...] = ("queue_full", "deadline", "quota", "brownout",
+                           "replica_lost")
 
 # The --quota spec key for the shared free pool.
 FREE_POOL = "*"
@@ -103,6 +107,21 @@ class DeadlineExceeded(AdmissionError):
     def __init__(self, message: str, *, priority: str = DEFAULT_PRIORITY,
                  tenant: Optional[str] = None) -> None:
         super().__init__(message, cause="deadline", priority=priority,
+                         tenant=tenant)
+
+
+class ReplicaLost(AdmissionError):
+    """Routing-tier verdict (tpuic/serve/router.py): the replica holding
+    this request died (or wedged past the watchdog) and the request was
+    NOT replayed — it was non-idempotent, its retry attempts were
+    exhausted, or the global retry budget was dry (a storm of failovers
+    must not amplify into a retry storm).  At-most-once delivery holds:
+    a ``replica_lost`` verdict means the caller may safely retry
+    end-to-end, knowing the router never emitted a response for it."""
+
+    def __init__(self, message: str, *, priority: str = DEFAULT_PRIORITY,
+                 tenant: Optional[str] = None) -> None:
+        super().__init__(message, cause="replica_lost", priority=priority,
                          tenant=tenant)
 
 

@@ -538,6 +538,13 @@ class InferenceEngine:
         """Blocking convenience: submit + wait for the result."""
         return self.submit(images).result(timeout)
 
+    def queue_depth(self) -> int:
+        """Requests queued but not yet popped by the batcher — the live
+        load signal the socket transport's ping response reports, which
+        the replica router folds into its least-loaded routing view
+        (docs/serving.md, "Replica routing and failover")."""
+        return self._queue.qsize()
+
     # -- batcher thread ------------------------------------------------
     def _maybe_shed(self, req: _Request) -> bool:
         """Pop-time deadline shed (docs/serving.md): True when ``req``'s

@@ -1,11 +1,19 @@
-"""Shared load-generation harness for driving an InferenceEngine.
+"""Shared load-generation harness for driving a serve endpoint.
 
 One paced submission driver and one counter-settling wait, used by
 ``bench_serve.py`` (closed-loop curves + the open-loop Poisson sweep),
-the perf-regression gate (``tpuic.telemetry.regress``), AND the CI
-overload soak (``scripts/overload_soak.py``) — a fix to the pacing or
-settling logic lands in every consumer, so the gate, the benchmark, and
-the soak cannot silently measure different things.
+the perf-regression gate (``tpuic.telemetry.regress``), the CI
+overload soak (``scripts/overload_soak.py``), AND the router soak
+(``scripts/router_soak.py``) — a fix to the pacing or settling logic
+lands in every consumer, so the gate, the benchmarks, and the soaks
+cannot silently measure different things.
+
+**Endpoint-aware**: the drive targets anything implementing the
+endpoint protocol — ``submit(item, **kw) -> Future`` plus a ``stats``
+object with ``reset()``/``snapshot()`` whose snapshot keeps the exact
+offered-traffic ledger.  An ``InferenceEngine`` and a
+``tpuic.serve.router.Router`` both qualify, so the same harness drives
+one engine in-process or a whole replica fleet over sockets.
 
 Workload items may carry per-request SLA fields: a bare array submits
 plainly; an ``(array, kwargs)`` pair forwards ``kwargs`` to
@@ -72,7 +80,8 @@ def probe_unbatched_rps(engine, reqs: Sequence,
 def run_stream(engine, reqs: Sequence, *,
                offsets_s: Optional[Sequence[float]] = None,
                result_timeout_s: float = 600.0,
-               on_done: Optional[Callable] = None
+               on_done: Optional[Callable] = None,
+               on_retry: Optional[Callable] = None
                ) -> Tuple[float, float, dict]:
     """Submit every item, wait for every outcome, settle the counters.
 
@@ -94,6 +103,14 @@ def run_stream(engine, reqs: Sequence, *,
     driver's own result-wait loop), inline for submit-time rejections
     (``ok=False, latency_s=None``).  The overload soak's per-class p99
     accounting rides this instead of duplicating the pacing loop.
+
+    ``on_retry(i, retries)``: optional retry outcome hook, fired
+    alongside ``on_done`` for items whose future carries a nonzero
+    ``tpuic_retries`` stamp — the endpoint contract the router uses to
+    report that item *i* was replayed ``retries`` times after a
+    replica loss.  An engine endpoint never stamps it, so the hook is
+    free there; the router soak's failover accounting rides this
+    instead of growing its own pacing loop.
 
     Returns ``(wall_s, arrival_s, snapshot)``: first submit -> last
     outcome, first submit -> last submit, and the settled stats.
@@ -122,11 +139,16 @@ def run_stream(engine, reqs: Sequence, *,
                 on_done(i, False, None)
             continue
         futs[i] = fut
-        if on_done is not None:
-            fut.add_done_callback(
-                lambda f, i=i, ts=ts: on_done(
-                    i, not f.cancelled() and f.exception() is None,
-                    time.perf_counter() - ts))
+        if on_done is not None or on_retry is not None:
+            def _settled(f, i=i, ts=ts):
+                if on_retry is not None:
+                    retries = getattr(f, "tpuic_retries", 0)
+                    if retries:
+                        on_retry(i, retries)
+                if on_done is not None:
+                    on_done(i, not f.cancelled() and f.exception() is None,
+                            time.perf_counter() - ts)
+            fut.add_done_callback(_settled)
     arrival_s = time.perf_counter() - t0
     resolved = 0
     for f in futs:

@@ -9,10 +9,11 @@ before/after evidence (docs/observability.md):
 
 - ``events``   — structured publish/subscribe **event bus** with JSONL /
   in-memory / TensorBoard sinks.  train/loop.py, checkpoint/manager.py,
-  data/folder.py, and serve/engine.py emit typed events (``step``,
-  ``epoch``, ``eval``, ``checkpoint_commit``, ``rollback``, ``skip``,
-  ``quarantine``, ``compile``, ``serve_batch``, ``trace``, ``goodput``)
-  into it instead of ad-hoc log lines.
+  data/folder.py, serve/engine.py, and the replica router
+  (serve/router.py) emit typed events (``step``, ``epoch``, ``eval``,
+  ``checkpoint_commit``, ``rollback``, ``skip``, ``quarantine``,
+  ``compile``, ``serve_batch``, ``trace``, ``goodput``,
+  ``router_*``) into it instead of ad-hoc log lines.
 - ``steptime`` — per-step wall-clock **breakdown** (data-wait vs.
   dispatch vs. device) from dispatch timestamps + the existing deferred
   drain: zero new host syncs, zero new compiles (asserted in
@@ -24,8 +25,8 @@ before/after evidence (docs/observability.md):
 - ``tracing``  — triggered ``jax.profiler`` windows: arms automatically
   when step time regresses past a multiple of the rolling median (or
   via ``TPUIC_TRACE=dir``), writing to a bounded trace dir.
-- ``prom``     — Prometheus-style text exposition of serve and train
-  counters (``python -m tpuic.serve --prom-dump/--prom-port``).
+- ``prom``     — Prometheus-style text exposition of serve, train, and
+  router counters (``--prom-dump/--prom-port``).
 - ``memory``   — per-device **memory accounting** sampled at step
   boundaries (allocator counters where the backend provides them,
   live-array bytes + RSS on CPU): ``memory`` events, TensorBoard
@@ -37,194 +38,70 @@ before/after evidence (docs/observability.md):
 - ``fleet``    — **per-rank fleet view**: rank-tagged events, per-rank
   JSONL streams, and the offline straggler-attribution aggregator
   (``python -m tpuic.telemetry.fleet <dir>``).
+- ``wiring``   — ``TrainTelemetry``, one training run's subscriber set.
 
 Everything is host-side: no module here ever calls ``jax.device_get``
 or adds device work (test-asserted), so telemetry can stay on in
 production hot loops.
+
+Re-exports resolve lazily (PEP 562, the tpuic/__init__.py idiom) so
+that importing this package — which stdlib-only parents do transitively
+via ``tpuic.telemetry.events`` and ``tpuic.telemetry.prom`` — never
+pulls jax/numpy into a supervisor or router process that must outlive
+any backend wedge (the same rule runtime/supervisor.py documents).
 """
 
 from __future__ import annotations
 
-import os
-from typing import Optional
-
-from tpuic.telemetry.events import (Event, EventBus, JsonlSink,  # noqa: F401
-                                    MemorySink, TensorBoardSink, bus,
-                                    install_jax_compile_listener, publish,
-                                    read_jsonl, subscribe)
-from tpuic.telemetry.flight import (FlightRecorder,  # noqa: F401
-                                    install_flight_recorder)
-from tpuic.telemetry.goodput import (GoodputTracker,  # noqa: F401
-                                     HBM_GBPS, PEAK_FLOPS,
-                                     analytic_flops_per_step,
-                                     hbm_bandwidth, peak_flops,
-                                     roofline_intensity)
-from tpuic.telemetry.memory import MemorySampler  # noqa: F401
-from tpuic.telemetry.slo import (Objective, SLOTracker,  # noqa: F401
-                                 parse_objectives)
-from tpuic.telemetry.steptime import StepTimer  # noqa: F401
-from tpuic.telemetry.tracing import TraceTrigger  # noqa: F401
-
-
-class TrainTelemetry:
-    """One training run's telemetry wiring over the process-global bus.
-
-    Owns the per-run subscribers (JSONL sink, step timer, goodput
-    tracker, trace trigger, TensorBoard bridge); the emitters
-    (checkpoint manager, dataset quarantine, jax compile listener)
-    publish to the global bus without knowing any of this exists.
-
-    Exactly one instance is live per process: constructing a new one
-    closes the previous run's subscribers first, so a sweep driver (or
-    a test session) building Trainer after Trainer never leaks bus
-    subscriptions or appends run B's events into run A's JSONL file.
-    """
-
-    def __init__(self, run_cfg, *, model_name: str = "", image_size: int = 0,
-                 global_batch: int = 0, n_devices: int = 1, device=None,
-                 tb=None) -> None:
-        global _active
-        if _active is not None:
-            _active.close()
-        _active = self
-        self._sinks = []
-        self._unsubs = []
-        # Compile events (the jax.monitoring bridge) feed the goodput
-        # compile bucket; idempotent, process-wide.
-        install_jax_compile_listener()
-        # Fleet view (telemetry/fleet.py, docs/observability.md): on a
-        # multi-process run every event gains rank/ranks fields (one
-        # dict merge at publish; single-process runs keep the tag off
-        # and pay one attribute read).
-        from tpuic.telemetry.fleet import rank_stream_path, tag_bus_with_rank
-        self.rank, self.ranks = tag_bus_with_rank(bus)
-        jsonl = getattr(run_cfg, "metrics_jsonl", "") or ""
-        if jsonl:
-            # Per-rank streams: rank 0 keeps the configured path (the
-            # single-process contract every consumer was built on);
-            # rank k writes '<stem>.rank<k>.jsonl' beside it — on a
-            # shared filesystem the fleet's whole history lands in one
-            # directory with no cross-process appends, and
-            # 'python -m tpuic.telemetry.fleet <dir>' merges it into
-            # straggler attribution offline.
-            sink = JsonlSink(rank_stream_path(jsonl, self.rank))
-            self._sinks.append(sink)
-            self._unsubs.append(bus.subscribe(sink))
-        # Supervised-liveness heartbeat (runtime/supervisor.py,
-        # docs/robustness.md): when a supervisor parent set
-        # TPUIC_HEARTBEAT_FILE for this process, mirror bus activity into
-        # the atomically rewritten heartbeat file. Pure host-side
-        # piggybacking on events the loop already publishes through its
-        # deferred drain — zero device syncs, zero compiles added
-        # (asserted in tests/test_supervisor.py with the
-        # tpuic.analysis.runtime checkers).
-        from tpuic.runtime.supervisor import HeartbeatWriter
-        self.heartbeat = HeartbeatWriter.from_env(publish=publish)
-        if self.heartbeat is not None:
-            self._unsubs.append(bus.subscribe(self.heartbeat))
-        self.steptime = StepTimer(bus)
-        # Device-memory accounting (telemetry/memory.py): one host-side
-        # metadata sample per step boundary — allocator counters where
-        # the backend provides them, live-array bytes + RSS on CPU.
-        # Zero device syncs, zero compiles (checker-asserted in
-        # tests/test_fleet.py, the same discipline as the StepTimer).
-        from tpuic.metrics.logging import host0_print
-        self.memory = MemorySampler(publish=bus.publish, log=host0_print)
-        self._unsubs.append(bus.subscribe(self.memory.on_event,
-                                          kinds=("step",)))
-        flops = analytic_flops_per_step(model_name, image_size, global_batch)
-        peak = peak_flops(device) * max(1, int(n_devices))
-        self.goodput = GoodputTracker(flops_per_step=flops, peak_flops=peak,
-                                      global_batch=global_batch)
-        self._unsubs.append(bus.subscribe(self.goodput.on_event))
-        # Step-time SLOs (telemetry/slo.py): attainment + error-budget
-        # burn over the 'step' events the StepTimer already publishes —
-        # one more host-side subscriber, nothing new on the hot path.
-        self.slo: Optional[SLOTracker] = None
-        slo_specs = getattr(run_cfg, "slo", "") or ""
-        if slo_specs:
-            self.slo = SLOTracker(parse_objectives(
-                slo_specs, allowed=("train_step",)))
-            self._unsubs.append(self.slo.attach(bus))
-        # Device-time attribution (telemetry/profile.py,
-        # docs/observability.md "Device-time attribution"): with
-        # run.trace_analyze set, captured trace windows are auto-analyzed
-        # into a per-op-class waterfall ('profile' events) and a final
-        # analysis runs at flush().  The Trainer wires the HLO provider
-        # (the AOT-lowered train step) after construction; until then
-        # the analyzer still ingests step device_ms — one deque append
-        # per step, zero syncs, zero compiles (test-asserted on-vs-off).
-        self.profile = None
-        if getattr(run_cfg, "trace_analyze", False):
-            # Imported lazily so `python -m tpuic.telemetry.profile`
-            # does not re-import its own module through this package.
-            from tpuic.telemetry.profile import CaptureAnalyzer
-            # PER-DEVICE peak/bandwidth, NOT x n_devices: the analyzed
-            # HLO is the SPMD-partitioned per-device program and the
-            # measured step time is the wall clock of its parallel
-            # execution — one device's roofline is the right ruler.
-            self.profile = CaptureAnalyzer(
-                peak=peak_flops(device),
-                hbm_bytes_per_s=hbm_bandwidth(device),
-                model_name=model_name, image_size=image_size,
-                global_batch=global_batch,
-                n_devices=max(1, int(n_devices)))
-            # 'trace' too: steps measured inside a profiler window are
-            # excluded from the waterfall's device distribution (the
-            # analyzer's observer-effect taint).  Subscribed BEFORE the
-            # tracer below, so the window-open/close ordering it sees is
-            # exact.
-            self._unsubs.append(bus.subscribe(self.profile.on_event,
-                                              kinds=("step", "trace")))
-        trace_dir = os.environ.get("TPUIC_TRACE", "") or \
-            getattr(run_cfg, "trace_dir", "") or ""
-        self.tracer: Optional[TraceTrigger] = None
-        if trace_dir:
-            self.tracer = TraceTrigger(
-                trace_dir,
-                threshold=float(getattr(run_cfg, "trace_threshold", 3.0)),
-                trace_steps=int(getattr(run_cfg, "trace_steps", 3)),
-                keep=int(getattr(run_cfg, "trace_keep", 4)),
-                # TPUIC_TRACE=dir is the manual override: capture one
-                # window immediately instead of waiting for a regression.
-                force_first=bool(os.environ.get("TPUIC_TRACE")),
-                on_capture=(self.profile.on_capture
-                            if self.profile is not None else None))
-            self._unsubs.append(bus.subscribe(self.tracer.on_event,
-                                              kinds=("step",)))
-        if tb is not None:
-            tbs = TensorBoardSink(tb)
-            # serve_batch/serve_span included: a train process never
-            # publishes them, but a process embedding both a Trainer and
-            # an InferenceEngine (predict-after-fit notebooks) gets its
-            # serve latencies as scalars through the same sink.
-            self._unsubs.append(bus.subscribe(
-                tbs, kinds=("step", "skip", "rollback", "quarantine",
-                            "goodput", "restart", "slo", "memory",
-                            "serve_batch", "serve_span", "profile")))
-
-    def flush(self) -> None:
-        if self.profile is not None:
-            # Run-end device-time analysis over the full step window
-            # (final=True) BEFORE the sinks flush, so the event lands in
-            # this run's JSONL.  The analyzer contains its own failures.
-            self.profile.finalize()
-        for s in self._sinks:
-            s.flush()
-
-    def close(self) -> None:
-        """Unsubscribe this run's consumers and close its sinks (the
-        global bus and emitters keep running for the process).
-        Idempotent."""
-        global _active
-        for unsub in self._unsubs:
-            unsub()
-        self._unsubs = []
-        for s in self._sinks:
-            s.close()
-        self._sinks = []
-        if _active is self:
-            _active = None
+_LAZY = {
+    # events (stdlib-only module — the cheap common case)
+    "Event": ("tpuic.telemetry.events", "Event"),
+    "EventBus": ("tpuic.telemetry.events", "EventBus"),
+    "JsonlSink": ("tpuic.telemetry.events", "JsonlSink"),
+    "MemorySink": ("tpuic.telemetry.events", "MemorySink"),
+    "TensorBoardSink": ("tpuic.telemetry.events", "TensorBoardSink"),
+    "bus": ("tpuic.telemetry.events", "bus"),
+    "install_jax_compile_listener": ("tpuic.telemetry.events",
+                                     "install_jax_compile_listener"),
+    "publish": ("tpuic.telemetry.events", "publish"),
+    "read_jsonl": ("tpuic.telemetry.events", "read_jsonl"),
+    "subscribe": ("tpuic.telemetry.events", "subscribe"),
+    # flight recorder
+    "FlightRecorder": ("tpuic.telemetry.flight", "FlightRecorder"),
+    "install_flight_recorder": ("tpuic.telemetry.flight",
+                                "install_flight_recorder"),
+    # goodput / roofline
+    "GoodputTracker": ("tpuic.telemetry.goodput", "GoodputTracker"),
+    "HBM_GBPS": ("tpuic.telemetry.goodput", "HBM_GBPS"),
+    "PEAK_FLOPS": ("tpuic.telemetry.goodput", "PEAK_FLOPS"),
+    "analytic_flops_per_step": ("tpuic.telemetry.goodput",
+                                "analytic_flops_per_step"),
+    "hbm_bandwidth": ("tpuic.telemetry.goodput", "hbm_bandwidth"),
+    "peak_flops": ("tpuic.telemetry.goodput", "peak_flops"),
+    "roofline_intensity": ("tpuic.telemetry.goodput",
+                           "roofline_intensity"),
+    # memory / slo / steptime / tracing
+    "MemorySampler": ("tpuic.telemetry.memory", "MemorySampler"),
+    "Objective": ("tpuic.telemetry.slo", "Objective"),
+    "SLOTracker": ("tpuic.telemetry.slo", "SLOTracker"),
+    "parse_objectives": ("tpuic.telemetry.slo", "parse_objectives"),
+    "StepTimer": ("tpuic.telemetry.steptime", "StepTimer"),
+    "TraceTrigger": ("tpuic.telemetry.tracing", "TraceTrigger"),
+    # per-run wiring
+    "TrainTelemetry": ("tpuic.telemetry.wiring", "TrainTelemetry"),
+}
 
 
-_active: Optional[TrainTelemetry] = None
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: next access skips the import
+        return value
+    raise AttributeError(
+        f"module 'tpuic.telemetry' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

@@ -43,7 +43,13 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                "rollback", "skip", "quarantine", "compile", "serve_batch",
                "serve_span", "slo", "admission", "trace", "goodput",
-               "restart", "heartbeat", "memory", "flight_dump", "profile")
+               "restart", "heartbeat", "memory", "flight_dump", "profile",
+               # Replica-router tier (tpuic/serve/router.py,
+               # docs/serving.md "Replica routing and failover"):
+               # per-replica lifecycle/health transitions, circuit-breaker
+               # state changes, budgeted retries, and in-flight failover.
+               "router_replica", "router_breaker", "router_retry",
+               "router_failover")
 
 
 @dataclasses.dataclass(frozen=True)

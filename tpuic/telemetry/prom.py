@@ -279,6 +279,107 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     return render(rows, prefix=prefix)
 
 
+_BREAKER_CODE = {"closed": 0.0, "half_open": 0.5, "open": 1.0}
+_REPLICA_STATE_CODE = {"starting": 0.0, "up": 1.0, "wedged": 2.0,
+                       "down": 3.0, "failed": 4.0, "stopped": 5.0}
+
+
+def router_exposition(snapshot: dict,
+                      prefix: str = "tpuic_router") -> str:
+    """``Router.snapshot()`` -> Prometheus text (tpuic/serve/router.py,
+    docs/serving.md "Replica routing and failover").
+
+    Fleet-level counters (the exact offered-traffic ledger: ``offered ==
+    requests + rejected + errors``), the retry budget gauge, end-to-end
+    latency quantiles, and per-replica rows — health state and breaker
+    state as numeric codes (state: 0=starting 1=up 2=wedged 3=down
+    4=failed 5=stopped; breaker: 0=closed 0.5=half_open 1=open) so a
+    dashboard can alert on a replica leaving 1/0.  Deliberately no
+    ``process_rss_bytes`` row: that helper imports the jax-backed
+    metrics stack, and the router process is stdlib-only by contract."""
+    rows: List[Tuple] = [
+        ("offered_total", snapshot.get("offered"), "counter",
+         "requests offered to the router", None),
+        ("requests_total", snapshot.get("requests"), "counter",
+         "requests resolved with a result", None),
+        ("errors_total", snapshot.get("errors"), "counter",
+         "untyped request failures (decode errors, bugs)", None),
+        ("retries_total", snapshot.get("retries"), "counter",
+         "budgeted failover replays", None),
+        ("failovers_total", snapshot.get("failovers"), "counter",
+         "replica-loss failover events", None),
+        ("failover_requeued_total", snapshot.get("failover_requeued"),
+         "counter", "in-flight requests requeued to a survivor", None),
+        ("failover_lost_total", snapshot.get("failover_lost"), "counter",
+         "in-flight requests resolved replica_lost", None),
+        ("duplicate_responses_total", snapshot.get("duplicates"),
+         "counter", "late/duplicate replica responses dropped by the "
+         "at-most-once id dedupe", None),
+        ("wire_errors_total", snapshot.get("wire_errors"), "counter",
+         "replica lines with an id the router never issued (torn "
+         "framing / protocol errors — alert: not benign dedupe)", None),
+        ("elapsed_seconds", snapshot.get("elapsed_s"), "gauge",
+         "seconds since stats reset", None),
+    ]
+    for cause, by_prio in (snapshot.get("rejected_by") or {}).items():
+        for prio, n in (by_prio or {}).items():
+            rows.append(("rejected_total", n, "counter",
+                         "typed verdicts by cause (queue_full|deadline|"
+                         "quota|brownout|replica_lost) and priority",
+                         {"cause": cause, "priority": prio}))
+    budget = snapshot.get("retry_budget") or {}
+    rows.append(("retry_budget_tokens", budget.get("tokens"), "gauge",
+                 "remaining retry-budget tokens (deposits = ratio x "
+                 "successes; one whole token per replay)", None))
+    rows.append(("retry_budget_denied_total", budget.get("denied"),
+                 "counter", "replays denied by a dry retry budget",
+                 None))
+    for q, v in (snapshot.get("latency_ms") or {}).items():
+        rows.append(("latency_ms", v, "gauge",
+                     "submit->resolve latency percentiles over the "
+                     "sliding window", {"quantile": q}))
+    for name, rep in sorted((snapshot.get("replicas") or {}).items()):
+        labels = {"replica": name}
+        rows.append(("replica_state", _REPLICA_STATE_CODE.get(
+            rep.get("state")), "gauge",
+            "replica health state (0=starting 1=up 2=wedged 3=down "
+            "4=failed 5=stopped)", labels))
+        rows.append(("replica_breaker_state", _BREAKER_CODE.get(
+            (rep.get("breaker") or {}).get("state")), "gauge",
+            "circuit-breaker state (0=closed 0.5=half_open 1=open)",
+            labels))
+        rows.append(("replica_breaker_transitions_total",
+                     (rep.get("breaker") or {}).get("transitions"),
+                     "counter", "breaker state transitions", labels))
+        rows.append(("replica_inflight", rep.get("inflight"), "gauge",
+                     "requests in flight on this replica", labels))
+        rows.append(("replica_routed_total", rep.get("routed"),
+                     "counter", "requests routed to this replica",
+                     labels))
+        rows.append(("replica_transport_failures_total",
+                     rep.get("transport_failures"), "counter",
+                     "transport failures (send errors, ping timeouts, "
+                     "connection loss)", labels))
+        rows.append(("replica_spill_limit", rep.get("spill_limit"),
+                     "gauge", "in-flight ceiling before load spills "
+                     "past this replica (Little's law at the committed "
+                     "knee)", labels))
+        rows.append(("replica_brownout_level", rep.get("brownout_level"),
+                     "gauge", "brownout level scraped from the "
+                     "replica's own exposition", labels))
+        rows.append(("replica_queue_depth", rep.get("queue_depth"),
+                     "gauge", "engine queue depth from the last pong",
+                     labels))
+        rows.append(("replica_heartbeat_age_seconds",
+                     rep.get("heartbeat_age_s"), "gauge",
+                     "age of the replica's supervisor heartbeat file",
+                     labels))
+        rows.append(("replica_spawns_total", rep.get("spawns"),
+                     "counter", "times this replica was (re)spawned",
+                     labels))
+    return render(rows, prefix=prefix)
+
+
 def train_exposition(report: dict, steptime: Optional[dict] = None,
                      prefix: str = "tpuic_train",
                      heartbeat_age_s: Optional[float] = None,
