@@ -428,6 +428,99 @@ def test_breaker_half_open_rejoins_restarted_replica(tmp_path):
         survivor.kill()
 
 
+def test_condemned_socket_runs_down_path_and_reconnects(tmp_path):
+    """Regression: when the SENDER condemns a socket (a failed request
+    or ping send calls close_socket, which nulls ``rep.sock`` before
+    shutting the fd down), the reader must still run the down/failover
+    path.  The old ``rep.sock is sock`` guard was always false in that
+    shape: in-flight requests hung until drain and the replica sat in
+    state "up" with no socket, never reconnecting."""
+    victim, survivor = FakeReplica(hold=True), FakeReplica()
+    r = _router(tmp_path, [victim, survivor])
+    try:
+        fut = r.submit(line={"path": "v.png"}, timeout=5, client_id="v")
+        _wait(lambda: len(victim.held) == 1, msg="victim holding")
+        # The condemned-socket shape, exactly as the send/ping failure
+        # paths produce it.  The FakeReplica itself stays alive, so
+        # only the router-side down path can notice anything.
+        r.replicas[0].close_socket()
+        rec = fut.result(timeout=10)      # failover, not a hang
+        assert rec["id"] == "v" and rec["replica"] == "r1"
+        assert r.stats.snapshot()["failover_requeued"] == 1
+        # the still-listening attached replica is reconnected (the old
+        # bug left it wedged in "up" with sock=None forever)
+        _wait(lambda: (r.replicas[0].state == "up"
+                       and r.replicas[0].sock is not None),
+              msg="victim reconnect after condemned socket")
+    finally:
+        r.close(drain=False)
+        victim.kill()
+        survivor.kill()
+
+
+def test_ping_send_failure_fails_over_in_flight(tmp_path):
+    """Regression: a ping-path transport failure runs the down path
+    directly — breaker trip, socket condemned, in-flight requeued to a
+    survivor — instead of only closing the socket and leaving the
+    replica routable."""
+    victim, survivor = FakeReplica(hold=True), FakeReplica()
+    r = _router(tmp_path, [victim, survivor])
+    try:
+        fut = r.submit(line={"path": "v.png"}, timeout=5, client_id="v")
+        _wait(lambda: len(victim.held) == 1, msg="victim holding")
+
+        def boom(rec):
+            raise OSError("stubbed ping transport failure")
+
+        r.replicas[0].send_line = boom    # next ping tick hits it
+        rec = fut.result(timeout=10)
+        assert rec["replica"] == "r1"
+        snap = r.stats.snapshot()
+        assert snap["failover_requeued"] == 1
+        assert snap["replicas"]["r0"]["breaker"]["state"] == "open"
+    finally:
+        r.close(drain=False)
+        victim.kill()
+        survivor.kill()
+
+
+def test_retry_queue_pops_by_due_time_not_fifo(tmp_path):
+    """Regression: the replay queue orders by due time — a long-backoff
+    entry queued FIRST must not head-of-line block an already-due
+    replay behind it (the FIFO deque broke exactly that, delaying
+    failover into the retry window)."""
+    victim, survivor = FakeReplica(hold=True), FakeReplica()
+    r = _router(tmp_path, [victim, survivor], max_attempts=6,
+                retry_backoff_s=0.3, retry_backoff_cap_s=2.0,
+                breaker_cooldown_s=0.6)
+    try:
+        # Open the survivor's breaker so BOTH requests route to the
+        # held victim; the 0.6s cooldown outlasts the submit setup (so
+        # a slow machine can't leak a half-open probe to the survivor
+        # early) yet expires well before the 2.0s capped backoff, so
+        # the due replay is admitted with margin under the 1.5s bound.
+        r.replicas[1].breaker.trip("test setup")
+        fut1 = r.submit(line={"path": "slow.png"}, timeout=5)
+        _wait(lambda: len(victim.held) == 1, msg="first held")
+        with r._lock:
+            # Aged replay: 4 prior attempts -> 0.3 * 2**3 = 2.4s
+            # backoff, capped at 2.0s.  Queued first on failover.
+            next(iter(r.replicas[0].inflight.values())).attempts = 4
+        fut2 = r.submit(line={"path": "fast.png"}, timeout=5)
+        _wait(lambda: len(victim.held) == 2, msg="both held")
+        t_kill = time.monotonic()
+        victim.kill()
+        rec2 = fut2.result(timeout=10)    # 0.3s backoff, behind fut1
+        assert rec2["replica"] == "r1"
+        assert time.monotonic() - t_kill < 1.5, \
+            "due replay was head-of-line blocked behind a longer backoff"
+        assert fut1.result(timeout=10)["replica"] == "r1"
+    finally:
+        r.close(drain=False)
+        victim.kill()  # idempotent; covers a failure before the mid-body kill
+        survivor.kill()
+
+
 # -- drain -------------------------------------------------------------------
 def test_drain_sheds_new_and_resolves_stragglers_typed(tmp_path):
     holder = FakeReplica(hold=True)

@@ -415,7 +415,7 @@ def _probs_forward(variables, images):
     return probs, jnp.argsort(-probs, axis=-1)
 
 
-def _socket_server(tmp_path, **engine_kw):
+def _socket_server(tmp_path, names=None, **engine_kw):
     """A live serve_socket around a stub engine, on a background
     thread; returns (engine, guard, ready, stop)."""
     import threading
@@ -433,7 +433,7 @@ def _socket_server(tmp_path, **engine_kw):
     eng.warmup()
     guard = _FakeGuard()
     ready_file = str(tmp_path / "ready.json")
-    names = {i: str(i) for i in range(3)}
+    names = names or {i: str(i) for i in range(3)}
     t = threading.Thread(
         target=serve_socket, daemon=True,
         kwargs=dict(engine=eng, listen="127.0.0.1:0", names=names,
@@ -531,6 +531,56 @@ def test_serve_socket_sigterm_drains_with_typed_stragglers(tmp_path):
     import os
     assert not os.path.exists(str(tmp_path / "ready.json")), \
         "a stopped replica must remove its ready file"
+
+
+def test_serve_socket_stalled_peer_does_not_stall_loop(tmp_path):
+    """Regression: sends are non-blocking with per-connection out
+    buffers drained via the select writable set — a peer that stops
+    reading used to wedge the single-threaded loop in 5s blocking
+    sendalls, starving pings on every OTHER connection past the
+    router's 3s window (breaker accruals on healthy links) and
+    stalling the supervisor heartbeat with them."""
+    import socket as _socket
+
+    from tpuic.serve import wire
+
+    # Huge class names make each response record ~150KB, so a handful
+    # of unread responses reliably overflow the kernel socket buffers
+    # into the server's userspace out buffer.
+    big = {i: chr(ord("a") + i) * 50_000 for i in range(3)}
+    eng, guard, ready, stop = _socket_server(tmp_path, names=big)
+    stalled = _socket.socket()
+    try:
+        port = ready["port"]
+        rng = np.random.default_rng(13)
+        img = rng.integers(0, 256, (1, SIZE, SIZE, 3), np.uint8)
+        stalled.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+        stalled.connect(("127.0.0.1", port))
+        stalled.sendall(b"".join(
+            (json.dumps({"id": f"s{i}", **wire.encode_array(img)})
+             + "\n").encode() for i in range(16)))
+        time.sleep(1.0)  # responses pile up behind the unread peer
+        t0 = time.monotonic()
+        recs = _sock_request(port, [{"op": "ping", "id": "p"}], 1,
+                             timeout=10.0)
+        assert recs and recs[0]["op"] == "pong"
+        assert time.monotonic() - t0 < 2.0, \
+            "stalled peer starved a healthy connection's ping"
+        # The slow reader still gets every response, complete and
+        # correctly framed through the partial-send path.
+        stalled.settimeout(20.0)
+        out, buf = [], b""
+        while len(out) < 16:
+            chunk = stalled.recv(1 << 16)
+            if not chunk:
+                break
+            *rs, buf = (buf + chunk).split(b"\n")
+            out.extend(json.loads(x) for x in rs if x.strip())
+        assert {r["id"] for r in out} == {f"s{i}" for i in range(16)}
+        assert all(len(r["pred"]) == 50_000 for r in out)
+    finally:
+        stalled.close()
+        stop()
 
 
 def test_replica_fault_points_registered():

@@ -145,9 +145,17 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
     log(f"[serve] socket-JSONL transport on {host}:{bound}"
         + (f" (ready file {ready_file})" if ready_file else ""))
 
-    conns: dict = {}  # socket -> {"buf": bytes, "pending": deque}
+    # socket -> {"buf": bytes, "out": bytearray, "out_ofs": int,
+    #            "pending": deque}; "out" holds unsent response bytes
+    # from index "out_ofs" on (cleared when fully drained, so its
+    # truthiness means "has pending output" at every check site).
+    conns: dict = {}
     served = 0
     accepted = 0  # request counter: the fault points' step axis
+    # A peer that stops reading grows its out buffer without bound;
+    # past this the connection is condemned (the router's failover
+    # handles its in-flight) rather than ballooning the replica.
+    max_out_buf = 8 << 20
 
     def close_conn(sock) -> None:
         st = conns.pop(sock, None)
@@ -163,11 +171,45 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
             # retrieved" noise.
             fut.add_done_callback(lambda f: f.cancelled() or f.exception())
 
-    def send(sock, rec: dict) -> None:
+    def pump_out(sock) -> None:
+        """Drain as much of the connection's out buffer as the kernel
+        will take WITHOUT blocking.  A stalled peer must never stall
+        the select loop: one slow sendall here used to freeze pings to
+        every OTHER connection (and the supervisor heartbeat) for up
+        to its 5s timeout — longer than the router's 3s ping window —
+        so healthy links accrued breaker failures for this peer's
+        sins.
+
+        The buffer is a bytearray consumed via an offset (compacted
+        every 256KB) so a slow drain costs one memmove per compaction,
+        not a full copy of the multi-MB remainder per partial send."""
+        st = conns.get(sock)
+        if st is None or not st["out"]:
+            return
         try:
-            sock.sendall((json.dumps(rec) + "\n").encode())
+            n = sock.send(memoryview(st["out"])[st["out_ofs"]:])
+        except (BlockingIOError, InterruptedError):
+            return  # kernel buffer full: the writable set drains it
         except OSError:
             close_conn(sock)
+            return
+        st["out_ofs"] += n
+        if st["out_ofs"] >= len(st["out"]):
+            del st["out"][:]
+            st["out_ofs"] = 0
+        elif st["out_ofs"] > (1 << 18):
+            del st["out"][:st["out_ofs"]]
+            st["out_ofs"] = 0
+
+    def send(sock, rec: dict) -> None:
+        st = conns.get(sock)
+        if st is None:
+            return
+        st["out"] += (json.dumps(rec) + "\n").encode()
+        if len(st["out"]) - st["out_ofs"] > max_out_buf:
+            close_conn(sock)  # peer stopped reading: conclusive
+            return
+        pump_out(sock)
 
     def handle_line(sock, st, raw: str) -> None:
         nonlocal accepted
@@ -246,19 +288,27 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
 
     try:
         while not guard.triggered:
+            # Only pending futures need the fast poll tick: buffered
+            # output is event-driven — its socket sits in the writable
+            # set, and select wakes the instant the kernel can take
+            # more, so a stalled peer costs zero spin.
             busy = any(s["pending"] for s in conns.values())
             try:
-                ready, _, _ = select.select([srv] + list(conns), [], [],
-                                            0.005 if busy else 0.1)
+                ready, writable, _ = select.select(
+                    [srv] + list(conns),
+                    [s for s, st in conns.items() if st["out"]], [],
+                    0.005 if busy else 0.1)
             except (OSError, ValueError):
                 break
+            for sock in writable:
+                pump_out(sock)
             for sock in ready:
                 if sock is srv:
                     try:
                         c, _ = srv.accept()
-                        c.setblocking(True)
-                        c.settimeout(5.0)  # a stalled peer must not wedge sendall
-                        conns[c] = {"buf": b"", "pending": deque()}
+                        c.setblocking(False)  # sends buffer, never stall
+                        conns[c] = {"buf": b"", "out": bytearray(),
+                                    "out_ofs": 0, "pending": deque()}
                     except OSError:
                         pass
                     continue
@@ -267,6 +317,8 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
                     continue
                 try:
                     chunk = sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue  # spurious wakeup on a non-blocking sock
                 except OSError:
                     chunk = b""
                 if not chunk:
@@ -274,6 +326,13 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
                     continue
                 *lines, st["buf"] = (st["buf"] + chunk).split(b"\n")
                 for raw in lines:
+                    if sock not in conns:
+                        # handle_line condemned the connection (send
+                        # failure, out-buffer overflow): the rest of
+                        # this pipelined chunk has nobody to answer to
+                        # — submitting it would strand futures on the
+                        # orphaned state dict past close_conn's sweep.
+                        break
                     if raw.strip():
                         handle_line(sock, st, raw.decode("utf-8", "replace"))
             for sock in list(conns):
@@ -292,6 +351,7 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
                 for sock in list(conns):
                     if sock in conns:
                         flush(sock, conns[sock])
+                        pump_out(sock)
                 time.sleep(0.02)
             for sock in list(conns):
                 st = conns.get(sock)
@@ -304,6 +364,20 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
                         rid, "drain timeout: engine shutting down "
                         "before this request finished"))
                 st["pending"] = deque()
+        # Flush buffered response bytes before the finally closes the
+        # sockets — a typed straggler line still sitting in an out
+        # buffer is a silent drop from the peer's point of view.
+        flush_deadline = time.monotonic() + 2.0
+        while (any(s["out"] for s in conns.values())
+               and time.monotonic() < flush_deadline):
+            try:
+                _, writable, _ = select.select(
+                    [], [s for s, st in conns.items() if st["out"]],
+                    [], 0.05)
+            except (OSError, ValueError):
+                break
+            for sock in writable:
+                pump_out(sock)
     finally:
         for sock in list(conns):
             close_conn(sock)

@@ -69,6 +69,7 @@ open → half-open → closed rejoin, exact ledger.
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import json
 import math
@@ -600,7 +601,14 @@ class Router:
         self.ledger_path = ledger_path or os.path.join(
             self.state_dir, "router_ledger.jsonl")
         self._wire_ids = itertools.count(1)
-        self._retryq: deque = deque()  # (due_t, _Request)
+        # Replay queue: a HEAP on due time, not a FIFO — entries carry
+        # attempt-dependent backoffs (up to retry_backoff_cap_s), so a
+        # long-backoff head must not head-of-line block already-due
+        # replays behind it.  The seq tiebreaks equal due times
+        # (_Request is not orderable) and keeps same-instant replays
+        # FIFO.
+        self._retryq: List[Tuple[float, int, _Request]] = []
+        self._retry_seq = itertools.count()
         self._draining = False
         self._closed = False
         self._pump: Optional[threading.Thread] = None
@@ -862,14 +870,16 @@ class Router:
             # A failed sendall may have left a PARTIAL line on the
             # socket: the connection's framing is indeterminate and
             # every later request on it would be corrupted — conclusive
-            # for this connection, exactly like a recv error.  Closing
-            # it EOFs the reader, which runs the down/failover path for
-            # whatever else is in flight here.
+            # for this connection, exactly like a recv error.  Pop our
+            # own wire id first (this request is handled HERE), then
+            # run the down path directly — it trips the breaker, closes
+            # the socket, and fails over whatever else is in flight.
+            # Don't wait for the reader to notice the close: whether
+            # the sender or the reader sees the failure first is a
+            # race, and _on_replica_down is idempotent either way.
             with self._lock:
                 owned = rep.inflight.pop(wire_id, None) is not None
-            rep.transport_failures += 1
-            rep.breaker.record_failure(f"send: {e}")
-            rep.close_socket()
+            self._on_replica_down(rep, f"send: {e}")
             if not owned:
                 # The reader's failover beat us to the pop and owns the
                 # request now (replay or typed verdict) — a second
@@ -908,9 +918,18 @@ class Router:
             for raw in lines:
                 if raw.strip():
                     self._on_line(rep, raw.decode("utf-8", "replace"))
-        if not self._stop.is_set() and rep.sock is sock:
-            # EOF/error on the live socket (not a close()/reconnect
-            # replacing it): the replica is gone.
+        with rep._send_lock:
+            # rep.sock is sock: EOF/error on the live socket — the
+            # replica is gone.  rep.sock is None: somebody condemned
+            # THIS connection (close_socket nulls the slot before
+            # shutting the fd down) — the down path must still run,
+            # and _on_replica_down's state check makes the second
+            # call from a racing sender/ping a no-op.  Only a non-None
+            # DIFFERENT socket means a reconnect already replaced this
+            # connection; downing the replica then would kill the new
+            # link.
+            replaced = rep.sock is not None and rep.sock is not sock
+        if not self._stop.is_set() and not replaced:
             self._on_replica_down(rep, reason)
 
     def _on_line(self, rep: _Replica, raw: str) -> None:
@@ -1010,7 +1029,9 @@ class Router:
                     req.retry_deadline = (time.monotonic()
                                           + self.retry_window_s)
                 with self._lock:
-                    self._retryq.append((time.monotonic() + delay, req))
+                    heapq.heappush(self._retryq,
+                                   (time.monotonic() + delay,
+                                    next(self._retry_seq), req))
                 self._publish("router_retry", replica=rep.name,
                               attempt=req.attempts + 1,
                               backoff_s=round(delay, 4),
@@ -1040,7 +1061,7 @@ class Router:
                                   if rep.child else None))
         self._log(f"{rep.name}: WEDGE — heartbeat stale {age:.1f}s; "
                   "escalating SIGQUIT→TERM→KILL")
-        rep.close_socket()  # reader EOF -> failover of in-flight
+        rep.close_socket()  # reader EOF -> immediate failover of in-flight
 
         def _ladder() -> None:
             try:
@@ -1048,7 +1069,13 @@ class Router:
                     rep.child.escalate(quit_wait_s=2.0,
                                        grace_s=self.grace_s)
             finally:
-                self._on_replica_down(rep, "wedge escalation")
+                # Backstop only: the reader's EOF normally ran the
+                # down path long before the ladder finishes.  Gate on
+                # still-WEDGED so a replica that was already downed
+                # AND respawned (state STARTING by now) is not
+                # condemned a second time.
+                if rep.state == WEDGED:
+                    self._on_replica_down(rep, "wedge escalation")
 
         threading.Thread(target=_ladder, daemon=True,
                          name=f"tpuic-router-escalate-{rep.name}").start()
@@ -1078,7 +1105,7 @@ class Router:
             with self._lock:
                 if not self._retryq or self._retryq[0][0] > now:
                     break
-                _, req = self._retryq.popleft()
+                _, _, req = heapq.heappop(self._retryq)
             handled, why = self._try_once(req)
             if handled:
                 continue
@@ -1093,7 +1120,9 @@ class Router:
                 requeue.append((now + 0.05, req))
         if requeue:
             with self._lock:
-                self._retryq.extend(requeue)
+                for due, req in requeue:
+                    heapq.heappush(self._retryq,
+                                   (due, next(self._retry_seq), req))
 
     def _pump_replica(self, rep: _Replica, now: float) -> None:
         if rep.state == UP:
@@ -1102,12 +1131,13 @@ class Router:
                 try:
                     rep.send_line({"op": "ping", "id": f"hp{rep.idx}"})
                 except OSError as e:
-                    rep.transport_failures += 1
-                    rep.breaker.record_failure(f"ping send: {e}")
                     # A torn ping corrupts the framing for everything
-                    # after it — conclusive; the reader EOF runs the
-                    # down/failover path.
-                    rep.close_socket()
+                    # after it — conclusive: run the down/failover
+                    # path directly (trips the breaker, closes the
+                    # socket, requeues in-flight) instead of waiting
+                    # for the reader to notice the close.
+                    self._on_replica_down(rep, f"ping send: {e}")
+                    return
             if (not rep.live(now)
                     and now - rep.connected_at > self.ping_timeout_s
                     and now - rep._last_timeout_fail > self.ping_timeout_s):
@@ -1257,7 +1287,7 @@ class Router:
             for rep in self.replicas:
                 stragglers.extend(rep.inflight.values())
                 rep.inflight.clear()
-            stragglers.extend(req for _, req in self._retryq)
+            stragglers.extend(req for _, _, req in self._retryq)
             self._retryq.clear()
         n = 0
         for req in stragglers:
