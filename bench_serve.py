@@ -118,7 +118,7 @@ def _engine_run(engine, reqs, rate: float) -> dict:
 
 
 def _poisson_run(engine, reqs, rate: float, seed: int,
-                 grace_s: float, deadline_ms=None) -> dict:
+                 grace_s: float, deadline_ms=None, dtype=None) -> dict:
     """Open-loop offered load: submissions follow a seeded Poisson
     process at ``rate`` req/s and never wait for results — the arrival
     process is independent of service, so queueing delay is *measured*,
@@ -141,8 +141,12 @@ def _poisson_run(engine, reqs, rate: float, seed: int,
 
     from tpuic.serve import loadgen
     rng = np.random.default_rng(seed)
-    items = (reqs if deadline_ms is None
-             else [(r, {"deadline_ms": deadline_ms}) for r in reqs])
+    kw = {}
+    if deadline_ms is not None:
+        kw["deadline_ms"] = deadline_ms
+    if dtype is not None:
+        kw["dtype"] = dtype  # ladder rung (docs/performance.md)
+    items = reqs if not kw else [(r, dict(kw)) for r in reqs]
     # Cumulative exponential gaps = a Poisson arrival process; handing
     # the shared driver precomputed offsets keeps arrivals independent
     # of service by construction.
@@ -166,6 +170,47 @@ def _poisson_run(engine, reqs, rate: float, seed: int,
         "shed": snap["rejected"],
         "shed_rate": round(snap["rejected"] / max(1, len(reqs)), 4),
     }
+
+
+def _dtype_ladder_sweep(engine, size: int, n_req: int, seed: int,
+                        knee_factor: float, tags, anchor: dict) -> dict:
+    """Per-dtype open-loop knee: the SAME Poisson rate ladder (anchored
+    once, to the shared dual probe) offered to each configured rung via
+    run_stream's submit kwargs, so the rungs' knees are directly
+    comparable.  Zero steady-state compiles asserted per rung from the
+    run's own compile counters — the AOT contract holds for every
+    (dtype, bucket) executable, not just fp32's."""
+    reqs = _request_stream(n_req, 1, size, seed)
+    unbatched_rps = anchor["unbatched_req_per_sec"]
+    service_s = anchor["unbatched_service_ms"] / 1000.0
+    ladder = {}
+    for t_i, tag in enumerate(tags):
+        curve, knee = [], None
+        for i, frac in enumerate((0.5, 1.0, 1.5, 2.0, 3.0)):
+            pt = _poisson_run(engine, reqs,
+                              max(1.0, frac * unbatched_rps),
+                              seed + 1000 * t_i + i, grace_s=service_s,
+                              dtype=tag)
+            pt["fraction_of_unbatched"] = frac
+            curve.append(pt)
+        base_p99 = curve[0]["latency_ms"].get("p99") or 0.0
+        for pt in curve:
+            p99 = pt["latency_ms"].get("p99") or 0.0
+            if pt["saturated"] or p99 > knee_factor * max(base_p99, 1e-9):
+                break
+            knee = pt
+        compiles = sum(pt["compiles_during_run"] for pt in curve)
+        ladder[tag] = {
+            "knee_req_per_sec": (knee["offered_req_per_sec"]
+                                 if knee is not None else None),
+            "knee_p50_ms": (knee["latency_ms"].get("p50")
+                            if knee is not None else None),
+            "knee_p99_ms": (knee["latency_ms"].get("p99")
+                            if knee is not None else None),
+            "steady_compiles": compiles,
+            "curve": curve,
+        }
+    return ladder
 
 
 def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
@@ -193,6 +238,14 @@ def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
     # soak, so the gate and this benchmark anchor identically.
     unbatched_rps, service_s, probe_raw_s, stall_s = \
         loadgen.probe_unbatched_rps(engine, reqs)
+    # The OTHER half of the dual anchor (PR-9's overload-soak fix,
+    # shared via loadgen): full-batching burst capacity.  Recording
+    # BOTH probes in the artifact makes container-speed noise in the
+    # committed knee (39.27 vs 68.8 req/s across runs of the same
+    # machine class) diagnosable — a knee wobble with stable probes is
+    # scheduler jitter; a knee wobble tracking the probes is the
+    # machine — instead of silently absorbed.
+    batched_rps = loadgen.probe_batched_rps(engine, reqs)
     curve, knee = [], None
     for i, frac in enumerate(fractions):
         pt = _poisson_run(engine, reqs, max(1.0, frac * unbatched_rps),
@@ -238,6 +291,7 @@ def _open_loop_sweep(engine, size: int, n_req: int, seed: int,
         "probe_coalesce_stall_ms": round(1000.0 * stall_s, 3),
         "unbatched_service_ms": round(1000.0 * service_s, 3),
         "unbatched_req_per_sec": round(unbatched_rps, 2),
+        "batched_burst_req_per_sec": round(batched_rps, 2),
         "knee_factor": knee_factor,
         "curve": curve,
         "knee": ({"offered_req_per_sec": knee["offered_req_per_sec"],
@@ -282,6 +336,11 @@ def main(argv=None) -> int:
     p.add_argument("--knee-factor", type=float, default=3.0,
                    help="p99 multiple over the lightest rung that "
                         "defines the latency knee")
+    p.add_argument("--dtypes", default="fp32,bf16,int8",
+                   help="serve dtype ladder (comma list of "
+                        "fp32,bf16,int8): per-dtype open-loop knees "
+                        "land in detail.dtype_ladder, each rung "
+                        "accuracy-gated and compile-counter-asserted")
     p.add_argument("--out", default=os.path.join("perf", "bench_serve.json"))
     args = p.parse_args(argv)
 
@@ -313,18 +372,44 @@ def main(argv=None) -> int:
     seq = _sequential(forward, variables, reqs)
 
     import numpy as np
+
+    from tpuic import quant
+    tags = tuple(dict.fromkeys(
+        ["fp32"] + [t.strip() for t in args.dtypes.split(",") if t.strip()]))
+    variants = quant.serve_variants(model, variables, tags, normalize=True)
     engine = InferenceEngine(
         forward_fn=forward, variables=variables, image_size=args.size,
         input_dtype=np.uint8, buckets=buckets,
-        max_wait_ms=args.max_wait_ms, queue_size=max(64, args.requests))
+        max_wait_ms=args.max_wait_ms, queue_size=max(64, args.requests),
+        variants={k: v for k, v in variants.items() if k != "fp32"})
     warmup_s = engine.warmup()
     curves = []
     for rate_s in args.rates.split(","):
         curves.append(_engine_run(engine, reqs, float(rate_s)))
-    open_loop = None
+    open_loop = dtype_ladder = accuracy = None
     if not args.no_open_loop:
         open_loop = _open_loop_sweep(engine, args.size, args.open_requests,
                                      args.seed, args.knee_factor)
+        if len(tags) > 1:
+            # Per-rung knees off the SAME anchor + the accuracy gate
+            # result the ladder ships under (docs/performance.md,
+            # "Quantized serving").
+            dtype_ladder = _dtype_ladder_sweep(
+                engine, args.size, args.open_requests, args.seed,
+                args.knee_factor, tags, open_loop)
+            eval_imgs = quant.eval_images(256, args.size)
+            ref = jax.jit(variants["fp32"][0])
+            accuracy = {"epsilon": quant.DEFAULT_EPSILON}
+            for tag in tags:
+                if tag == "fp32":
+                    continue
+                fwd, qv = variants[tag]
+                agree = quant.top1_agreement(ref, variants["fp32"][1],
+                                             jax.jit(fwd), qv, eval_imgs)
+                accuracy[tag] = {
+                    "top1_agreement": round(agree, 4),
+                    "gate": "ok" if agree >= 1.0 - quant.DEFAULT_EPSILON
+                            else "FAILED"}
     engine.close()
 
     best = max(curves, key=lambda c: c["images_per_sec"])
@@ -334,6 +419,9 @@ def main(argv=None) -> int:
                                for pt in open_loop["curve"])
         steady_compiles += sum(pt["compiles_during_run"]
                                for pt in open_loop["shed_curve"])
+    if dtype_ladder is not None:
+        steady_compiles += sum(r["steady_compiles"]
+                               for r in dtype_ladder.values())
     result = {
         "metric": "serve_images_per_sec_cpu_synthetic",
         "value": best["images_per_sec"],
@@ -356,6 +444,8 @@ def main(argv=None) -> int:
             "warmup_compile_s": warmup_s,
             "offered_load_curve": curves,
             "open_loop": open_loop,
+            "dtype_ladder": dtype_ladder,
+            "quant_accuracy": accuracy,
             "sequential_baseline": seq,
             "vs_sequential_cold": round(best["images_per_sec"]
                                         / seq["cold_images_per_sec"], 3),

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Native-decode prefetch before/after -> perf/native_prefetch.json.
+
+The zero-cost-input claim, measured on the pinned CPU telemetry
+workload (the regress gate's train.py invocation, forced onto the
+**decode path** with --no-pack so every sample decodes in the Loader's
+prefetch workers each epoch):
+
+- **off**: --no-native — PIL decode + NumPy resize/augment/normalize
+  per sample (the parity reference).
+- **on**: the native core — ``decode_resize`` (libjpeg DCT-scaled /
+  libpng + the shared nearest-resize index math) + the fused
+  ``prep_image`` pass, still in the same prefetch workers, now cheap
+  enough that decode keeps ahead of the (tiny, CPU) train step.
+
+The artifact records the per-step telemetry ``input`` (data-wait)
+bucket and the goodput ``frac_input`` both ways, plus a **parity**
+block: one batch loaded through both paths must match exactly (PNG
+fixtures — the native decode is bitwise the NumPy path there, pinned
+by tests/test_native.py).
+
+    python scripts/native_prefetch_bench.py --out perf/native_prefetch.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _run_workload(work: str, steps: int, native: bool) -> dict:
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.telemetry.events import read_jsonl
+
+    data = os.path.join(work, "data")
+    if not os.path.isdir(data):
+        make_synthetic_imagefolder(data, classes=("a", "b", "c"),
+                                   per_class=8, size=32)
+    tag = "native" if native else "numpy"
+    jsonl = os.path.join(work, f"events_{tag}.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TF_CPP_MIN_LOG_LEVEL="3")
+    env.pop("TPUIC_TRACE", None)
+    env.pop("TPUIC_FAULTS", None)
+    cmd = [sys.executable, os.path.join(_REPO, "train.py"),
+           "--datadir", data, "--model", "resnet18-cifar",
+           "--resize", "32", "--batchsize", "2",
+           "--epochs", str(steps // 12 + 1), "--optimizer", "adam",
+           "--lr", "1e-3", "--no-class-weights", "--log-every-steps", "1",
+           "--ckpt-dir", os.path.join(work, f"cp_{tag}"),
+           "--steps", str(steps), "--metrics-jsonl", jsonl,
+           "--no-pack"] + ([] if native else ["--no-native"])
+    proc = subprocess.run(cmd, cwd=_REPO, env=env, text=True,
+                          capture_output=True, timeout=1200)
+    if proc.returncode != 0:
+        raise RuntimeError(f"workload ({tag}) exited {proc.returncode}:\n"
+                           f"{proc.stdout[-1200:]}\n{proc.stderr[-1200:]}")
+    recs = read_jsonl(jsonl)
+    steps_ev = [r for r in recs if r["event"] == "step"]
+    final = [r for r in recs if r["event"] == "goodput"
+             and r.get("final")][0]
+    data_ms = [float(r.get("data_ms", 0.0)) for r in steps_ev[1:]]
+    return {
+        "steps": len(steps_ev),
+        "input_ms_mean": round(sum(data_ms) / max(1, len(data_ms)), 3),
+        "input_ms_max": round(max(data_ms or [0.0]), 3),
+        "frac_input": final.get("frac_input"),
+        "input_s_total": final.get("input_s"),
+    }
+
+
+def _parity(work: str) -> dict:
+    """One sample loaded through both paths must be identical (PNG)."""
+    import dataclasses
+
+    import numpy as np
+
+    from tpuic.config import DataConfig
+    from tpuic.data.folder import ImageFolderDataset
+
+    data = os.path.join(work, "data")
+    cfg = DataConfig(data_dir=data, resize_size=32, native=True)
+    ds_nat = ImageFolderDataset(data, "train", 32, cfg)
+    ds_np = ImageFolderDataset(data, "train", 32,
+                               dataclasses.replace(cfg, native=False))
+    worst = 0.0
+    for idx in range(0, len(ds_nat), 3):
+        rng1 = np.random.default_rng([0, 0, idx])
+        rng2 = np.random.default_rng([0, 0, idx])
+        a, la, ia = ds_nat.load(idx, rng1)
+        b, lb, ib = ds_np.load(idx, rng2)
+        assert (la, ia) == (lb, ib)
+        worst = max(worst, float(np.abs(a - b).max()))
+    if worst > 2e-5:  # color-op float rounding; geometry is bitwise
+        raise AssertionError(f"native/NumPy parity broken: {worst}")
+    return {"samples_checked": len(range(0, len(ds_nat), 3)),
+            "max_abs_diff": worst}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--out", default=os.path.join("perf",
+                                                 "native_prefetch.json"))
+    p.add_argument("--workdir", default="")
+    args = p.parse_args(argv)
+
+    from tpuic import native
+    work = args.workdir or tempfile.mkdtemp(prefix="tpuic_native_bench_")
+    os.makedirs(work, exist_ok=True)
+    try:
+        off = _run_workload(work, args.steps, native=False)
+        on = _run_workload(work, args.steps, native=True)
+        parity = _parity(work)
+        out = {
+            "metric": "input_bucket_ms_native_prefetch",
+            "workload": {"train_steps": args.steps, "batch": 2,
+                         "size": 32, "path": "decode (--no-pack)"},
+            "native_core": {"prep": native.available(),
+                            "decode": native.decode_available()},
+            "numpy_path": off,
+            "native_path": on,
+            "input_ms_mean_reduction": round(
+                off["input_ms_mean"] - on["input_ms_mean"], 3),
+            "parity": parity,
+            "note": ("pinned CPU telemetry workload forced onto the "
+                     "per-epoch decode path; the production packed path "
+                     "already measures ~0 input by serving memmap rows "
+                     "(docs/performance.md). The native decode+prep in "
+                     "the prefetch workers is the same win for the "
+                     "unpacked/first-epoch case."),
+        }
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps({k: out[k] for k in
+                          ("numpy_path", "native_path",
+                           "input_ms_mean_reduction", "parity")},
+                         indent=None))
+        return 0
+    finally:
+        if not args.workdir:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,12 +187,10 @@ def main(argv=None) -> int:
     #    off-arm meets its SLO) and the soak proves nothing in either
     #    direction. Anchoring to max(knee, unbatched, batched) keeps the
     #    off-arm provably saturated at any machine speed.
-    from tpuic.serve.loadgen import probe_unbatched_rps, run_stream
+    from tpuic.serve.loadgen import probe_batched_rps, probe_unbatched_rps
     local_rps, _, _, _ = probe_unbatched_rps(engine, reqs)
-    n_cap = min(400, args.requests)
-    t_cap = time.perf_counter()
-    run_stream(engine, reqs[:n_cap])
-    batched_rps = n_cap / max(time.perf_counter() - t_cap, 1e-9)
+    batched_rps = probe_batched_rps(engine, reqs,
+                                    probe_n=min(400, args.requests))
     knee = _committed_knee()
     drive_rps = args.overload_factor * max(knee, local_rps, batched_rps)
 

@@ -93,9 +93,25 @@ def main() -> int:
         if not any("layer" in k for k in (wf.get("layers") or {})):
             return fail(f"per-layer rollup names no model layers: "
                         f"{list((wf.get('layers') or {}))[:5]}")
+        # The analytic-FLOPs cross-check (goodput.check_flops_drift)
+        # must stay inside the 10% warning threshold: the table feeds
+        # every in-band MFU number, and PR 10's 43% resnet18-cifar
+        # finding (a MAC count pasted as FLOPs) is exactly the rot this
+        # assertion keeps fixed.
+        drift = wf.get("analytic_flops_drift")
+        if drift is None:
+            return fail("waterfall carries no analytic_flops_drift "
+                        "cross-check (table or cost analysis missing "
+                        "for the pinned workload model)")
+        if drift >= 0.10:
+            return fail(f"analytic FLOPs table drifts {100 * drift:.1f}% "
+                        f">= 10% from the compiler's count — fix "
+                        f"FWD_FLOPS_PER_IMAGE (goodput.py) and re-derive "
+                        f"the regression baseline via --write-baseline")
         print(f"[profile-smoke] waterfall OK: {len(classes)} classes sum "
               f"{total:.2f} ms vs device bucket {bucket:.2f} ms/step "
-              f"({100 * gap:.2f}%), "
+              f"({100 * gap:.2f}%), analytic-FLOPs drift "
+              f"{100 * drift:.1f}% (<10%), "
               f"{len(wf.get('layers') or {})} layers, "
               f"{wf.get('tainted_steps_excluded', 0)} tainted steps "
               f"excluded")

@@ -9,7 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuic.kernels import flash_attention, fused_weighted_cross_entropy
+from tpuic.kernels import (flash_attention, fold_bn, fused_conv_bn_relu,
+                           fused_weighted_cross_entropy)
 from tpuic.train.loss import weighted_cross_entropy
 from _gates import requires_shard_map
 
@@ -211,6 +212,139 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, block_q=8, block_k=8)
         assert out.dtype == jnp.bfloat16
         assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+def _conv_ref(x, w, scale, bias, strides, padding, relu):
+    """Unfused reference: lax conv + BN-affine + ReLU."""
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y = y * scale + bias
+    return jnp.maximum(y, 0) if relu else y
+
+
+class TestFusedConvBNRelu:
+    """tpuic/kernels/conv_bn_relu.py: numerics parity atol 1e-4 /
+    rtol 1e-4 (documented in ModelConfig.fused_conv_bn — the tap-matmul
+    f32 accumulation order differs from XLA's convolution; measured
+    ~1e-7 on the model zoo in float32)."""
+
+    CASES = [
+        # (h, w, cin, cout, k, stride, pad) — the ResNet shapes:
+        (8, 8, 3, 16, 3, 1, 1),      # conv3x3 stride 1
+        (9, 11, 4, 8, 3, 2, 1),      # conv3x3 stride 2, odd dims
+        (32, 32, 3, 16, 7, 2, 3),    # the 7x7/s2 stem
+        (8, 8, 16, 32, 1, 2, 0),     # downsample conv1x1 stride 2
+        (8, 8, 16, 32, 1, 1, 0),     # bottleneck conv1x1
+    ]
+
+    def _case(self, key, h, w, cin, cout, k):
+        rng = np.random.default_rng(key)
+        x = jnp.asarray(rng.standard_normal((2, h, w, cin)), jnp.float32)
+        wk = jnp.asarray(0.1 * rng.standard_normal((k, k, cin, cout)),
+                         jnp.float32)
+        sc = jnp.asarray(rng.standard_normal(cout), jnp.float32)
+        bi = jnp.asarray(rng.standard_normal(cout), jnp.float32)
+        return x, wk, sc, bi
+
+    @pytest.mark.parametrize("h,w,cin,cout,k,s,p", CASES)
+    def test_matches_unfused_reference(self, h, w, cin, cout, k, s, p):
+        x, wk, sc, bi = self._case(h + k + s, h, w, cin, cout, k)
+        got = fused_conv_bn_relu(x, wk, sc, bi, strides=s, padding=p)
+        want = _conv_ref(x, wk, sc, bi, (s, s), ((p, p), (p, p)), True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_relu_off_for_residual_tail(self):
+        """relu=False is the pre-residual-add case: negative values
+        must survive."""
+        x, wk, sc, bi = self._case(7, 8, 8, 4, 8, 3)
+        got = fused_conv_bn_relu(x, wk, sc, bi, padding=1, relu=False)
+        want = _conv_ref(x, wk, sc, bi, (1, 1), ((1, 1), (1, 1)), False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        assert float(jnp.min(got)) < 0.0
+
+    def test_under_jit_compiled_program(self):
+        """'Compiled mode' on the CPU suite: the kernel inside one
+        jitted program (interpret lowers through XLA; on TPU the same
+        call compiles via Mosaic).  Values must match the eager
+        interpret run bitwise — one lowering, two entry paths."""
+        x, wk, sc, bi = self._case(11, 8, 8, 4, 8, 3)
+
+        @jax.jit
+        def prog(x, wk, sc, bi):
+            return fused_conv_bn_relu(x, wk, sc, bi, strides=1, padding=1)
+
+        eager = fused_conv_bn_relu(x, wk, sc, bi, strides=1, padding=1)
+        np.testing.assert_array_equal(np.asarray(prog(x, wk, sc, bi)),
+                                      np.asarray(eager))
+
+    def test_fold_bn_matches_flax_batchnorm(self):
+        """fold_bn must reproduce nn.BatchNorm(use_running_average)
+        exactly: y = (x - mean) * gamma * rsqrt(var + eps) + beta."""
+        rng = np.random.default_rng(3)
+        c = 12
+        x = jnp.asarray(rng.standard_normal((4, 5, 5, c)), jnp.float32)
+        gamma = jnp.asarray(rng.standard_normal(c), jnp.float32)
+        beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+        mean = jnp.asarray(rng.standard_normal(c), jnp.float32)
+        var = jnp.asarray(rng.random(c) + 0.1, jnp.float32)
+        scale, bias = fold_bn(gamma, beta, mean, var, eps=1e-5)
+        want = (x - mean) * (gamma * jax.lax.rsqrt(var + 1e-5)) + beta
+        np.testing.assert_allclose(np.asarray(x * scale + bias),
+                                   np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_output_dtype_follows_input(self):
+        x, wk, sc, bi = self._case(13, 8, 8, 4, 8, 3)
+        out = fused_conv_bn_relu(x.astype(jnp.bfloat16), wk, sc, bi,
+                                 padding=1)
+        assert out.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+    @pytest.mark.parametrize("name,size", [("resnet18-cifar", 32),
+                                           ("resnet50", 64),
+                                           ("resnet50-s2d", 64)])
+    def test_resnet_fused_inference_parity(self, name, size):
+        """The model-zoo wiring (ModelConfig.fused_conv_bn): identical
+        parameter structure (checkpoints interchangeable), inference
+        parity within the documented atol, and the TRAIN path bitwise
+        untouched (the fused branch must never engage when BN needs
+        batch statistics)."""
+        from tpuic.models import create_model
+
+        base = create_model(name, 10, dtype="float32")
+        fused = create_model(name, 10, dtype="float32",
+                             fused_conv_bn=True)
+        v = base.init(jax.random.key(0), jnp.zeros((2, size, size, 3)),
+                      train=False)
+        v2 = fused.init(jax.random.key(0), jnp.zeros((2, size, size, 3)),
+                        train=False)
+        assert (jax.tree_util.tree_structure(v)
+                == jax.tree_util.tree_structure(v2))
+        x = jax.random.normal(jax.random.key(1), (2, size, size, 3))
+        a = base.apply(v, x, train=False)
+        b = fused.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+        at, _ = base.apply(v, x, train=True, mutable=["batch_stats"])
+        bt, _ = fused.apply(v, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_array_equal(np.asarray(at), np.asarray(bt))
+
+    def test_config_plumb(self):
+        """ModelConfig.fused_conv_bn reaches the ResNet module; the
+        non-ResNet families accept and ignore the flag."""
+        from tpuic.config import ModelConfig
+        from tpuic.models import create_model, create_model_from_config
+
+        m = create_model_from_config(ModelConfig(
+            name="resnet18-cifar", num_classes=7, dtype="float32",
+            fused_conv_bn=True))
+        assert m.backbone.fused_inference is True
+        # Non-ResNet backbones take the flag without blowing up.
+        create_model("vit-tiny", 7, fused_conv_bn=True)
+        create_model("efficientnet-b0", 7, fused_conv_bn=True)
+        create_model("inceptionv3", 7, fused_conv_bn=True)
 
 
 class TestFusedCrossEntropy:

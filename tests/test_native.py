@@ -68,6 +68,105 @@ class TestFusedPrep:
                                           _numpy_ref(img, 64))
 
 
+class TestNativeDecode:
+    """The native decode path (libtpuic_decode.so) wired into the
+    per-sample prefetch-worker decode (folder._decode_sized) — the
+    zero-cost-input thrust's parity + fallback + quarantine contract."""
+
+    decode_mark = pytest.mark.skipif(
+        not __import__("tpuic.native", fromlist=["x"]).decode_available(),
+        reason="native decode core unavailable (no libjpeg/libpng)")
+
+    @decode_mark
+    @pytest.mark.parametrize("size", [16, 24, 64])
+    def test_png_decode_resize_bitwise_vs_numpy(self, size):
+        """PNG: libpng decode + the shared nearest-resize index math
+        must be BITWISE the PIL + transforms.resize_nearest pixels —
+        the golden-pixel parity the prefetch path rides on."""
+        import io
+
+        from PIL import Image
+        img = _img(size)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        got = native.decode_resize(buf.getvalue(), size)
+        assert got is not None and got.dtype == np.uint8
+        want = T.resize_nearest(np.asarray(Image.open(
+            io.BytesIO(buf.getvalue())).convert("RGB")), size)
+        np.testing.assert_array_equal(got, want)
+
+    @decode_mark
+    def test_jpeg_decode_close_to_pil(self):
+        """JPEG decodes DCT-scaled (the pack path's existing pixels):
+        not bitwise PIL, but the same image to small tolerance."""
+        import io
+
+        from PIL import Image
+        img = _img(7, 64, 64)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        got = native.decode_resize(buf.getvalue(), 64)
+        assert got is not None
+        want = np.asarray(Image.open(io.BytesIO(buf.getvalue()))
+                          .convert("RGB"))
+        assert np.mean(np.abs(got.astype(np.int32)
+                              - want.astype(np.int32))) < 8.0
+
+    @decode_mark
+    def test_corrupt_bytes_return_none(self):
+        assert native.decode_resize(b"\x89PNG\r\n\x1a\nnot-a-png", 16) \
+            is None
+        assert native.decode_resize(b"", 16) is None
+
+    def test_dataset_falls_back_when_decoder_absent(self, imagefolder,
+                                                    monkeypatch):
+        """cfg.native on but no decode .so: _decode_sized must serve
+        the PIL pixels (graceful fallback, identical output)."""
+        import dataclasses
+
+        from tpuic.config import DataConfig
+        from tpuic.data.folder import ImageFolderDataset
+
+        cfg = DataConfig(data_dir=imagefolder, resize_size=24, native=True)
+        ds = ImageFolderDataset(imagefolder, "val", 24, cfg)
+        ds_off = ImageFolderDataset(
+            imagefolder, "val", 24,
+            dataclasses.replace(cfg, native=False))
+        monkeypatch.setattr(native, "decode_available", lambda: False)
+        a, la, ida = ds.load(0)
+        b, lb, idb = ds_off.load(0)
+        assert (la, ida) == (lb, idb)
+        np.testing.assert_array_equal(a, b)
+
+    @decode_mark
+    def test_truncated_file_quarantines_through_prefetch_workers(
+            self, tmp_path):
+        """A truncated PNG on the NATIVE decode path: decode_resize
+        returns None, the PIL fallback raises, and the quarantine
+        ladder serves a same-class replacement — the epoch completes
+        through the Loader's real prefetch workers (docs/robustness.md
+        semantics preserved on the fast path)."""
+        from tpuic.config import DataConfig
+        from tpuic.data.folder import ImageFolderDataset
+        from tpuic.data.pipeline import Loader
+        from tpuic.data.synthetic import make_synthetic_imagefolder
+        from tpuic.runtime.faults import truncate_file
+
+        root = make_synthetic_imagefolder(
+            str(tmp_path / "data"), classes=("a", "b"), per_class=4,
+            size=24)
+        cfg = DataConfig(data_dir=root, resize_size=24, native=True,
+                         quarantine_retries=0, quarantine_backoff_s=0.0)
+        ds = ImageFolderDataset(root, "train", 24, cfg)
+        truncate_file(ds.samples[1][0])
+        loader = Loader(ds, global_batch=4, num_workers=2,
+                        process_index=0, process_count=1)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 2  # 8 samples / batch 4: epoch completed
+        assert ds.quarantine_count >= 1
+        assert ds.samples[1][0] in ds.quarantined
+
+
 class TestDatasetWiring:
     def test_native_and_numpy_loads_are_identical(self, imagefolder):
         """Same (seed, epoch, index) RNG stream => identical sample, so a run

@@ -1,0 +1,179 @@
+"""tpuic.quant: post-training int8/bf16 weight variants + accuracy gate
+(docs/performance.md, "Quantized serving")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic import quant
+
+
+def _rand_tree(key=0):
+    rng = np.random.default_rng(key)
+    return {
+        "params": {
+            "dense": {"kernel": jnp.asarray(
+                rng.standard_normal((16, 8)), jnp.float32),
+                "bias": jnp.asarray(rng.standard_normal(8), jnp.float32)},
+            "conv": {"kernel": jnp.asarray(
+                rng.standard_normal((3, 3, 4, 8)), jnp.float32)},
+            "bn": {"scale": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+        },
+        "batch_stats": {"bn": {"mean": jnp.zeros((8,)),
+                               "var": jnp.ones((8,))}},
+    }
+
+
+class TestAbsmaxQuantize:
+    def test_roundtrip_error_bounded_per_channel(self):
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.standard_normal((32, 16)) *
+                        rng.lognormal(0, 2, (1, 16)), jnp.float32)
+        q, scale = quant.absmax_quantize(w)
+        assert q.dtype == jnp.int8
+        assert scale.shape == (1, 16)
+        err = np.abs(np.asarray(q, np.float32) * np.asarray(scale)
+                     - np.asarray(w))
+        # Symmetric absmax: |error| <= scale/2 per channel, every channel
+        # (per-channel scaling is the point — a per-tensor scale would
+        # blow the bound on the small-magnitude channels).
+        assert np.all(err <= 0.5 * np.asarray(scale) + 1e-7)
+
+    def test_quantize_dequantize_structure_identity(self):
+        v = _rand_tree()
+        qv = quant.quantize_variables(v)
+        # kernels became {q, scale} dicts; calibration leaves untouched.
+        assert qv["params"]["dense"]["kernel"]["q"].dtype == jnp.int8
+        assert quant.QUANT_LEAF in qv["params"]["conv"]["kernel"]
+        np.testing.assert_array_equal(
+            np.asarray(qv["params"]["dense"]["bias"]),
+            np.asarray(v["params"]["dense"]["bias"]))
+        back = quant.dequantize_variables(qv)
+        assert (jax.tree_util.tree_structure(back)
+                == jax.tree_util.tree_structure(v))
+        np.testing.assert_allclose(
+            np.asarray(back["params"]["dense"]["kernel"]),
+            np.asarray(v["params"]["dense"]["kernel"]), atol=0.05)
+
+    def test_int8_tree_is_4x_smaller_on_weights(self):
+        from tpuic.models import create_model
+        model = create_model("resnet18-cifar", 10, dtype="float32")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 24, 24, 3)),
+                       train=False)
+        def nbytes(t):
+            return sum(x.size * np.dtype(x.dtype).itemsize
+                       for x in jax.tree_util.tree_leaves(t)
+                       if hasattr(x, "size"))
+        ratio = nbytes(v) / nbytes(quant.quantize_variables(v))
+        assert ratio > 3.5  # ~4x minus the f32 scales/biases/BN
+
+    def test_bf16_cast_floats_only(self):
+        v = _rand_tree()
+        bv = quant.bf16_variables(v)
+        assert bv["params"]["dense"]["kernel"].dtype == jnp.bfloat16
+        assert bv["params"]["dense"]["bias"].dtype == jnp.bfloat16
+
+
+class TestServeVariants:
+    @pytest.fixture(scope="class")
+    def model_and_vars(self):
+        from tpuic.models import create_model
+        model = create_model("resnet18-cifar", 10, dtype="float32")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 24, 24, 3)),
+                       train=False)
+        return model, v
+
+    def test_unknown_tag_raises(self, model_and_vars):
+        model, v = model_and_vars
+        with pytest.raises(ValueError, match="unknown serve dtype"):
+            quant.serve_variants(model, v, ("fp32", "int4"))
+
+    def test_accuracy_gate_clean_and_corrupted(self, model_and_vars):
+        """The bidirectional contract scripts/quant_gate.py enforces in
+        CI: clean rungs agree with fp32 within the committed epsilon on
+        the pinned eval set; a seeded weight corruption must land far
+        below the floor (the gate can fire)."""
+        model, v = model_and_vars
+        variants = quant.serve_variants(model, v,
+                                        ("fp32", "bf16", "int8"),
+                                        normalize=True)
+        imgs = quant.eval_images(128, 24)
+        ref_fwd, ref_v = variants["fp32"]
+        ref = jax.jit(ref_fwd)
+        floor = 1.0 - quant.DEFAULT_EPSILON
+        for tag in ("bf16", "int8"):
+            fwd, qv = variants[tag]
+            agree = quant.top1_agreement(ref, ref_v, jax.jit(fwd), qv,
+                                         imgs)
+            assert agree >= floor, (tag, agree)
+        bad = quant.quantize_variables(quant.corrupt_variables(v, seed=0))
+        agree_bad = quant.top1_agreement(
+            ref, ref_v, jax.jit(variants["int8"][0]), bad, imgs)
+        assert agree_bad < floor - 0.3  # fires with a wide margin
+
+    def test_eval_images_pinned(self):
+        a, b = quant.eval_images(16, 8), quant.eval_images(16, 8)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.uint8 and a.shape == (16, 8, 8, 3)
+
+
+class TestEngineDtypeLadder:
+    def test_per_dtype_executables_zero_steady_compiles(self):
+        """The engine-side contract (docs/performance.md): one AOT
+        cache keyed (variant, bucket), mixed-dtype traffic batches
+        variant-pure and adds ZERO steady-state compiles after a full
+        warmup — checker-asserted like every other serve invariant."""
+        from tpuic.analysis import runtime as contracts
+        from tpuic.models import create_model
+        from tpuic.serve import InferenceEngine
+
+        size = 16
+        model = create_model("resnet18-cifar", 10, dtype="float32")
+        v = model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)),
+                       train=False)
+        variants = quant.serve_variants(model, v,
+                                        ("fp32", "bf16", "int8"),
+                                        normalize=True)
+        eng = InferenceEngine(
+            forward_fn=variants["fp32"][0],
+            variables=variants["fp32"][1], image_size=size,
+            input_dtype=np.uint8, buckets=(1, 4), max_wait_ms=1.0,
+            variants={k: variants[k] for k in ("bf16", "int8")})
+        try:
+            timings = eng.warmup()
+            assert set(timings) == {"fp32", "bf16", "int8"}
+            assert eng.stats.compiles == 6  # 3 variants x 2 buckets
+            rng = np.random.default_rng(0)
+            reqs = [rng.integers(0, 256, (int(rng.integers(1, 5)),
+                                          size, size, 3), np.uint8)
+                    for _ in range(18)]
+            with contracts.assert_compiles_flat(
+                    what="dtype-ladder steady state"):
+                futs = [eng.submit(r, dtype=("fp32", "bf16", "int8")[i % 3])
+                        for i, r in enumerate(reqs)]
+                outs = [f.result(timeout=60) for f in futs]
+            assert len(outs) == len(reqs)
+            assert eng.stats.compiles == 6
+            # Each result matches ITS OWN rung's reference forward — a
+            # mixed stream must never cross-serve another rung's
+            # executable (batch purity).
+            for i, (r, (probs, order)) in enumerate(zip(reqs, outs)):
+                tag = ("fp32", "bf16", "int8")[i % 3]
+                fwd, qv = variants[tag]
+                want_p, want_o = jax.jit(fwd)(qv, r)
+                np.testing.assert_array_equal(np.asarray(order),
+                                              np.asarray(want_o))
+        finally:
+            eng.close()
+
+    def test_unknown_dtype_rejected_at_submit(self):
+        from tpuic.serve import InferenceEngine
+
+        def fwd(variables, images):
+            return (images.sum(axis=(1, 2, 3)),)
+
+        eng = InferenceEngine(forward_fn=fwd, variables={}, image_size=4,
+                              buckets=(1,), autostart=False)
+        with pytest.raises(ValueError, match="unknown serve dtype"):
+            eng.submit(np.zeros((1, 4, 4, 3), np.float32), dtype="int8")

@@ -254,6 +254,11 @@ BASE = {
     "train.step_p99_ms": 140.0, "train.frac_productive": 0.5,
     "train.accounted_frac": 0.99, "serve.latency_p50_ms": 20.0,
     "serve.latency_p99_ms": 45.0,
+    # The quantized serve ladder's rows (same time-class semantics).
+    "serve.bf16_latency_p50_ms": 22.0,
+    "serve.bf16_latency_p99_ms": 48.0,
+    "serve.int8_latency_p50_ms": 21.0,
+    "serve.int8_latency_p99_ms": 47.0,
     "serve.throughput_images_per_sec": 300.0,
     "serve.pad_efficiency": 0.8, "serve.steady_compiles": 0.0,
 }

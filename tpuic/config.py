@@ -151,6 +151,15 @@ class ModelConfig:
     # active for *-moe models, which sow 'moe_router' stats that the train
     # step turns into a padding-masked switch_aux_loss).
     moe_aux_weight: float = 0.01
+    # Inference-only Pallas fused conv+BN+ReLU for the ResNet family
+    # (tpuic/kernels/conv_bn_relu.py): every conv -> BN -> ReLU block of
+    # a train=False call runs as one VMEM-resident kernel (conv as tap
+    # matmuls, BN folded to a per-channel affine epilogue) instead of
+    # three HBM-roundtripping HLOs. Parameter structure is unchanged, so
+    # the flag flips on any existing checkpoint; training and non-ResNet
+    # backbones ignore it. Numerics parity vs the unfused graph is
+    # pinned in tests/test_kernels.py (atol 1e-4 in float32).
+    fused_conv_bn: bool = False
     # Attention implementation for attention-bearing backbones (ViT):
     # 'dense' (einsum softmax), 'flash' (Pallas blockwise online-softmax,
     # tpuic/kernels/flash_attention.py), 'ring' (sequence-parallel ring

@@ -169,6 +169,38 @@ class ImageFolderDataset:
             return np.asarray(im.convert("RGB") if im.mode not in ("RGB",)
                               else im)
 
+    def _decode_sized(self, index: int) -> np.ndarray:
+        """Decode sample ``index`` — through the native core when built
+        (``native.decode_resize``: libjpeg/libpng + the SAME
+        nearest-resize index math as ``transforms.resize_nearest``, so
+        PNG output is bitwise the PIL+NumPy path's), else PIL at full
+        resolution (the downstream ``resize_nearest`` no-ops when the
+        native path already returned target-size pixels).
+
+        This is the prefetch-worker decode (Loader workers call ``load``
+        off-thread): with the native core the per-sample cost drops to
+        one C decode+gather, so the telemetry ``input`` bucket on the
+        decode (--no-pack) path shrinks toward zero
+        (perf/native_prefetch.json).  JPEG decodes DCT-scaled — the
+        same pixels the packed cache (pack.py) already serves.  A
+        corrupt/truncated file makes the native decoder return None and
+        the PIL fallback raise, so the quarantine ladder engages
+        exactly as on the pure-NumPy path (tests/test_native.py)."""
+        path = self.samples[index][0]
+        if self.cfg.native:
+            from tpuic import native
+            if native.decode_available():
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    data = b""
+                if data:
+                    out = native.decode_resize(data, self.resize_size)
+                    if out is not None:
+                        return out
+        return self._decode(path)
+
     def quarantine_replacement(self, index: int) -> int:
         """Deterministic substitute for a sample whose file won't decode:
         the next index (cyclic) carrying the SAME label — the label stays
@@ -220,7 +252,7 @@ class ImageFolderDataset:
             # recovers).
             if _faults.fire("decode_error", step=i):
                 raise OSError(f"injected decode error for index {i}")
-            return self._decode(self.samples[i][0])
+            return self._decode_sized(i)
 
         img, index = quarantined_decode(self, index, _decode_index)
         path, label = self.samples[index]

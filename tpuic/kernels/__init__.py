@@ -4,6 +4,9 @@ Kernels compile to Mosaic on TPU; on CPU (CI, the 8-device mesh tests) they
 run in Pallas interpret mode so the same kernel logic is exercised everywhere.
 """
 
+from tpuic.kernels.conv_bn_relu import (fold_bn,  # noqa: F401
+                                        fused_conv_bn_from_flax,
+                                        fused_conv_bn_relu)
 from tpuic.kernels.cross_entropy import fused_weighted_cross_entropy  # noqa: F401
 from tpuic.kernels.flash_attention import flash_attention  # noqa: F401
 

@@ -43,7 +43,8 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                     bn_eps: float = 1e-5, attention: str = "dense",
                     mesh=None, bn_f32_stats: bool = True,
                     drop_path: float = 0.0, remat_core: bool = False,
-                    remat_blocks: bool = False, remat_mlp: bool = False):
+                    remat_blocks: bool = False, remat_mlp: bool = False,
+                    fused_conv_bn: bool = False):
     if name not in _REGISTRY:
         raise ValueError(f"unknown model '{name}'; available: {available_models()}")
     if attention not in ATTENTION_IMPLS:
@@ -55,7 +56,8 @@ def create_backbone(name: str, num_classes: int = 0, *, dtype=jnp.float32,
                    bn_eps=bn_eps, attention=attention, mesh=mesh,
                    bn_f32_stats=bn_f32_stats, drop_path=drop_path,
                    remat_core=remat_core, remat_blocks=remat_blocks,
-                   remat_mlp=remat_mlp), has_aux
+                   remat_mlp=remat_mlp,
+                   fused_conv_bn=fused_conv_bn), has_aux
 
 
 def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
@@ -66,7 +68,8 @@ def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                  drop_path: float = 0.0,
                  remat_core: bool = False,
                  remat_blocks: bool = False,
-                 remat_mlp: bool = False) -> Classifier:
+                 remat_mlp: bool = False,
+                 fused_conv_bn: bool = False) -> Classifier:
     dt, pdt = jnp.dtype(dtype), jnp.dtype(param_dtype)
     backbone, has_aux = create_backbone(name, num_classes, dtype=dt,
                                         param_dtype=pdt,
@@ -76,7 +79,8 @@ def create_model(name: str, num_classes: int, *, head_widths=(128, 64, 32),
                                         drop_path=drop_path,
                                         remat_core=remat_core,
                                         remat_blocks=remat_blocks,
-                                        remat_mlp=remat_mlp)
+                                        remat_mlp=remat_mlp,
+                                        fused_conv_bn=fused_conv_bn)
     return Classifier(backbone=backbone, num_classes=num_classes,
                       head_widths=tuple(head_widths), has_aux=has_aux,
                       dtype=dt, param_dtype=pdt)
@@ -103,19 +107,24 @@ def create_model_from_config(cfg: ModelConfig, mesh=None) -> Classifier:
                         # remat_mlp) — the mlp_up pre-activation is never
                         # a residual; see models/vit.py MlpUpGelu.
                         remat_mlp=(cfg.remat
-                                   and cfg.remat_policy == "gelu"))
+                                   and cfg.remat_policy == "gelu"),
+                        # Inference-only Pallas fused conv+BN+ReLU for
+                        # the ResNet family (kernels/conv_bn_relu.py);
+                        # training and non-ResNet backbones ignore it.
+                        fused_conv_bn=cfg.fused_conv_bn)
 
 
 def _register_builtins():
     def _rn(factory, **extra):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks, remat_mlp):
+                 remat_blocks, remat_mlp, fused_conv_bn):
             del (num_classes, attention, mesh, drop_path, remat_core,
                  remat_blocks, remat_mlp)
             return factory(dtype=dtype, param_dtype=param_dtype,
                            bn_momentum=bn_momentum, bn_eps=bn_eps,
-                           bn_f32_stats=bn_f32_stats, **extra)
+                           bn_f32_stats=bn_f32_stats,
+                           fused_inference=fused_conv_bn, **extra)
         return make
 
     register("resnet18", _rn(_resnet.resnet18))
@@ -132,11 +141,13 @@ def _register_builtins():
     def _eff(variant):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks, remat_mlp):
+                 remat_blocks, remat_mlp, fused_conv_bn):
             # torch effnet: eps 1e-3; f32 stats kept (experiment is
-            # ResNet-scoped, ModelConfig.bn_f32_stats).
+            # ResNet-scoped, ModelConfig.bn_f32_stats); fused conv+BN is
+            # ResNet-only too.
             del (num_classes, bn_eps, attention, mesh, bn_f32_stats,
-                 drop_path, remat_core, remat_blocks, remat_mlp)
+                 drop_path, remat_core, remat_blocks, remat_mlp,
+                 fused_conv_bn)
             return _effnet.efficientnet(variant, dtype=dtype,
                                         param_dtype=param_dtype,
                                         bn_momentum=bn_momentum)
@@ -148,8 +159,9 @@ def _register_builtins():
     def _vit_factory(ctor):
         def make(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
                  attention, mesh, bn_f32_stats, drop_path, remat_core,
-                 remat_blocks, remat_mlp):
+                 remat_blocks, remat_mlp, fused_conv_bn):
             del num_classes, bn_momentum, bn_eps, bn_f32_stats  # no BN in ViT
+            del fused_conv_bn  # ResNet-only
             return ctor(dtype=dtype, param_dtype=param_dtype,
                         attention=attention, mesh=mesh, drop_path=drop_path,
                         remat_core=remat_core, remat_blocks=remat_blocks,
@@ -169,10 +181,11 @@ def _register_builtins():
 
     def _inc(*, num_classes, dtype, param_dtype, bn_momentum, bn_eps,
              attention, mesh, bn_f32_stats, drop_path, remat_core,
-             remat_blocks, remat_mlp):
-        # torch inception: eps 1e-3 (module default); f32 stats kept.
+             remat_blocks, remat_mlp, fused_conv_bn):
+        # torch inception: eps 1e-3 (module default); f32 stats kept;
+        # fused conv+BN is ResNet-only.
         del (bn_eps, attention, mesh, bn_f32_stats, drop_path,
-             remat_core, remat_blocks, remat_mlp)
+             remat_core, remat_blocks, remat_mlp, fused_conv_bn)
         return _inception.InceptionV3(aux_classes=num_classes, dtype=dtype,
                                       param_dtype=param_dtype,
                                       bn_momentum=bn_momentum)

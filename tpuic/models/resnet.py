@@ -32,6 +32,29 @@ from flax import linen as nn
 from tpuic.models.layers import batch_norm, conv1x1, conv3x3
 
 
+def _fused_ready(mod: nn.Module, train: bool) -> bool:
+    """The fused-inference branch applies only when (a) the flag is on,
+    (b) this is an inference call (training BN needs batch statistics
+    the per-image kernel cannot see), and (c) the variables already
+    exist — init() must run the unfused branch so the parameter
+    structure (and therefore every checkpoint) is identical either way."""
+    return (mod.fused_inference and not train
+            and mod.has_variable("params", "conv1"))
+
+
+def _fused_cbr(mod: nn.Module, x, conv: str, bn: str, *, strides=1,
+               padding=0, relu=True):
+    """One fused conv+BN+ReLU call reading the UNFUSED branch's variables
+    (kernels/conv_bn_relu.py) — same params, same running stats, one
+    VMEM pass instead of conv-out/bn-out/relu-out HBM roundtrips."""
+    from tpuic.kernels import fused_conv_bn_from_flax
+    v = mod.variables
+    return fused_conv_bn_from_flax(
+        x, v["params"][conv]["kernel"], v["params"][bn],
+        v["batch_stats"][bn], strides=strides, padding=padding, relu=relu,
+        eps=mod.bn_eps)
+
+
 class BasicBlock(nn.Module):
     features: int
     strides: int = 1
@@ -40,9 +63,20 @@ class BasicBlock(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_f32_stats: bool = True
+    fused_inference: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        if _fused_ready(self, train):
+            y = _fused_cbr(self, x, "conv1", "bn1", strides=self.strides,
+                           padding=1)
+            y = _fused_cbr(self, y, "conv2", "bn2", padding=1, relu=False)
+            residual = x
+            if "downsample_conv" in self.variables["params"]:
+                residual = _fused_cbr(self, x, "downsample_conv",
+                                      "downsample_bn",
+                                      strides=self.strides, relu=False)
+            return nn.relu(y + residual)
         bn = partial(batch_norm, train, momentum=self.bn_momentum,
                      eps=self.bn_eps, dtype=self.dtype,
                      param_dtype=self.param_dtype,
@@ -69,9 +103,22 @@ class Bottleneck(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_f32_stats: bool = True
+    fused_inference: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        if _fused_ready(self, train):
+            y = _fused_cbr(self, x, "conv1", "bn1")
+            # torchvision places the stride on the 3x3 (v1.5 ResNet).
+            y = _fused_cbr(self, y, "conv2", "bn2", strides=self.strides,
+                           padding=1)
+            y = _fused_cbr(self, y, "conv3", "bn3", relu=False)
+            residual = x
+            if "downsample_conv" in self.variables["params"]:
+                residual = _fused_cbr(self, x, "downsample_conv",
+                                      "downsample_bn",
+                                      strides=self.strides, relu=False)
+            return nn.relu(y + residual)
         bn = partial(batch_norm, train, momentum=self.bn_momentum,
                      eps=self.bn_eps, dtype=self.dtype,
                      param_dtype=self.param_dtype,
@@ -106,18 +153,40 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_f32_stats: bool = True
+    # Inference-only Pallas fused conv+BN+ReLU (kernels/conv_bn_relu.py):
+    # identical parameter structure (init always runs the unfused branch),
+    # so the flag can be flipped on any existing checkpoint.
+    fused_inference: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
         x = x.astype(self.dtype)
+        fused = _fused_ready(self, train)
         # jax.named_scope tags ('stem'/'gap') thread the structural
         # phases flax's module path does not name into the HLO op
         # metadata — the device-time waterfall (telemetry/profile.py)
         # rolls layers up from exactly these paths; the blocks below are
         # already scoped by their flax module names (layerN_i).
         with jax.named_scope("stem"):
-            if self.small_stem:
+            if fused:
+                if self.small_stem:
+                    x = _fused_cbr(self, x, "conv1", "bn1", padding=1)
+                elif self.space_to_depth:
+                    b, h, w, c = x.shape
+                    if h % 2 or w % 2:
+                        raise ValueError(
+                            f"space_to_depth stem needs even H/W, "
+                            f"got {(h, w)}")
+                    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+                    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                        b, h // 2, w // 2, 4 * c)
+                    x = _fused_cbr(self, x, "conv1", "bn1",
+                                   padding=((2, 1), (2, 1)))
+                else:
+                    x = _fused_cbr(self, x, "conv1", "bn1", strides=2,
+                                   padding=3)
+            elif self.small_stem:
                 x = nn.Conv(self.num_filters, (3, 3), padding=1,
                             use_bias=False, **kw, name="conv1")(x)
             elif self.space_to_depth:
@@ -138,9 +207,12 @@ class ResNet(nn.Module):
             else:
                 x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
                             padding=3, use_bias=False, **kw, name="conv1")(x)
-            x = batch_norm(train, momentum=self.bn_momentum, eps=self.bn_eps,
-                           f32_stats=self.bn_f32_stats, **kw, name="bn1")(x)
-            x = nn.relu(x)
+            if not fused:  # the fused stem already applied bn1 + relu
+                x = batch_norm(train, momentum=self.bn_momentum,
+                               eps=self.bn_eps,
+                               f32_stats=self.bn_f32_stats, **kw,
+                               name="bn1")(x)
+                x = nn.relu(x)
             if not self.small_stem:
                 x = nn.max_pool(x, (3, 3), strides=(2, 2),
                                 padding=((1, 1), (1, 1)))
@@ -150,6 +222,7 @@ class ResNet(nn.Module):
                 x = self.block(self.num_filters * 2 ** stage, strides,
                                self.bn_momentum, self.bn_eps, self.dtype,
                                self.param_dtype, self.bn_f32_stats,
+                               fused_inference=self.fused_inference,
                                name=f"layer{stage + 1}_{i}")(x, train)
         with jax.named_scope("gap"):
             x = jnp.mean(x, axis=(1, 2))  # global average pool

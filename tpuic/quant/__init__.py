@@ -1,0 +1,211 @@
+"""Post-training quantization for the serve tier (docs/performance.md,
+"Quantized serving").
+
+Two weight-only variants of a trained checkpoint, built at engine
+start-up with zero retraining:
+
+- **bf16**: every floating leaf cast to bfloat16 — half the weight HBM
+  traffic; compute dtype is whatever the model was built with (flax
+  promotes per-layer), so a bf16-dtype model gives full bf16 compute
+  and an f32 model gives "bf16 storage, f32 math".
+- **int8**: absmax **per-output-channel** symmetric quantization of
+  every weight matrix/kernel (the last axis is the output channel in
+  both flax layouts — Dense ``[in, out]`` and Conv ``[kh, kw, in,
+  out]``): ``scale_c = absmax_c / 127``, ``q = round(w / scale)``.
+  Biases, BN parameters and running stats stay float32 (they are a
+  rounding error of the total bytes and carry the calibration).  The
+  dequantize (``q.astype(f32) * scale``) happens *inside* the compiled
+  program, so HBM holds int8 weights (4x smaller than f32) and XLA
+  fuses the widening into each consumer.
+
+The quantized tree swaps every quantized leaf for a
+``{'q': int8, 'scale': f32}`` dict, so it rides ``jax.device_put`` /
+the engine's variant plumbing like any other pytree;
+:func:`dequantize_variables` restores the exact original structure for
+``model.apply``.
+
+**Accuracy gate** (the serve ladder's admission contract): a quantized
+variant ships only when its top-1 predictions agree with fp32 on the
+pinned synthetic eval set within a committed epsilon
+(:func:`top1_agreement`, ``scripts/quant_gate.py``; CI runs it
+bidirectionally — a seeded weight corruption must FAIL the same gate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+QUANT_LEAF = "__tpuic_int8__"   # marker key of a quantized leaf dict
+DTYPE_TAGS = ("fp32", "bf16", "int8")
+# The committed accuracy epsilon: a quantized ladder rung must agree
+# with fp32 top-1 on at least (1 - epsilon) of the pinned eval set.
+# 0.1 is sized to the PINNED gate workload (a seeded random-init model,
+# whose near-zero logit margins make ~5% int8 top-1 flips intrinsic —
+# measured 0.941 int8 / 0.980 bf16 agreement on the pinned seed; a
+# trained checkpoint's margins put agreement well above 0.99).  The
+# must-fail corruption arm lands at ~0.0 agreement, so the gate keeps
+# a >9x firing margin both ways (scripts/quant_gate.py).
+DEFAULT_EPSILON = 0.1
+
+
+def absmax_quantize(w, axis: int = -1) -> Tuple[object, object]:
+    """Symmetric per-channel int8: returns ``(q, scale)`` with
+    ``q * scale ~= w``; ``scale`` keeps ``w``'s rank (size-1 axes) so
+    the dequant is one broadcast multiply."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(i for i in range(w.ndim)
+                        if i != (axis % w.ndim))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _is_weight(name: str, leaf) -> bool:
+    """Quantize matrix-shaped ``kernel``/``embedding`` leaves only: 1-D
+    vectors (biases, BN scale/bias/stats, positional params) carry the
+    model's calibration and are byte-trivial."""
+    return (getattr(leaf, "ndim", 0) >= 2
+            and name in ("kernel", "embedding"))
+
+
+def quantize_variables(variables) -> dict:
+    """Original variables tree -> the int8 tree the engine device_puts.
+
+    Every quantizable leaf becomes ``{QUANT_LEAF: True-shaped marker,
+    'q': int8, 'scale': f32}``; everything else (batch_stats included)
+    is float32 passthrough."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if not isinstance(v, dict) and _is_weight(k, v):
+                q, s = absmax_quantize(v)
+                out[k] = {QUANT_LEAF: 1, "q": q, "scale": s}
+            else:
+                out[k] = walk(v)
+        return out
+    return walk(dict(variables))
+
+
+def dequantize_variables(qvars, dtype=None):
+    """Inverse of :func:`quantize_variables`, run *inside* the compiled
+    forward: int8 leaves widen to ``dtype`` (float32 default) via one
+    fused multiply; passthrough leaves are returned untouched."""
+    import jax.numpy as jnp
+
+    dt = jnp.float32 if dtype is None else jnp.dtype(dtype)
+
+    def walk(node):
+        if isinstance(node, dict):
+            if QUANT_LEAF in node:
+                return (node["q"].astype(jnp.float32)
+                        * node["scale"]).astype(dt)
+            return {k: walk(v) for k, v in node.items()}
+        return node
+    return walk(qvars)
+
+
+def bf16_variables(variables):
+    """Cast every floating leaf to bfloat16 (weight-HBM halving; flax
+    promotes per-layer according to the model's compute dtype)."""
+    import jax
+    import jax.numpy as jnp
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.bfloat16)
+        return x
+    return jax.tree_util.tree_map(cast, variables)
+
+
+def quantized_forward(forward_fn, dtype=None):
+    """Wrap an engine forward so it accepts the int8 tree: dequantize
+    (inside jit — the executable's inputs stay int8), then run."""
+    def forward(qvariables, images):
+        return forward_fn(dequantize_variables(qvariables, dtype), images)
+    return forward
+
+
+def serve_variants(model, variables, tags, *, normalize: bool = False,
+                   mean=None, std=None) -> dict:
+    """``{tag: (forward_fn, variables)}`` for the engine's dtype ladder.
+
+    ``model`` + ``variables`` are the fp32 pair the checkpoint loader
+    returns; each tag shares the model's forward (serve/engine.py
+    ``make_forward``) with its own weight representation.  Unknown tags
+    raise up front — a typo'd ladder must fail the CLI, not serve fp32
+    under an int8 label."""
+    from tpuic.serve.engine import make_forward
+
+    base = make_forward(model, normalize=normalize, mean=mean, std=std)
+    out = {}
+    for tag in tags:
+        if tag == "fp32":
+            out[tag] = (base, variables)
+        elif tag == "bf16":
+            out[tag] = (base, bf16_variables(variables))
+        elif tag == "int8":
+            out[tag] = (quantized_forward(base),
+                        quantize_variables(variables))
+        else:
+            raise ValueError(f"unknown serve dtype {tag!r}; "
+                             f"supported: {DTYPE_TAGS}")
+    return out
+
+
+def corrupt_variables(variables, seed: int = 0, factor: float = 12.0):
+    """Seeded weight corruption for the accuracy gate's must-fail arm:
+    every quantizable kernel gets additive Gaussian noise at ``factor``
+    times its own std, drawn from a per-leaf key (the leaf's tree path
+    folded into ``seed``) — big enough to flip predictions,
+    deterministic so the CI proof is reproducible."""
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+
+    def walk(node, path=()):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if _is_weight(path[-1] if path else "", node):
+            k = jax.random.fold_in(
+                jax.random.key(seed),
+                zlib.crc32("/".join(path).encode()) & 0x7FFFFFFF)
+            noise = jax.random.normal(k, node.shape, jnp.float32)
+            return node + factor * jnp.std(node) * noise
+        return node
+    return walk(dict(variables))
+
+
+def top1_agreement(forward_a, vars_a, forward_b, vars_b, images,
+                   batch: int = 32) -> float:
+    """Fraction of the pinned eval images on which the two forwards
+    agree on the top-1 class — the accuracy-delta statistic the ladder
+    gate compares against the committed epsilon.  ``forward_*`` follow
+    the engine contract (``(probs, order)`` pytrees); images is
+    [N, S, S, C]."""
+    import numpy as np
+
+    n = images.shape[0]
+    agree = 0
+    for lo in range(0, n, batch):
+        chunk = images[lo:lo + batch]
+        _, oa = forward_a(vars_a, chunk)
+        _, ob = forward_b(vars_b, chunk)
+        agree += int(np.sum(np.asarray(oa)[:, 0] == np.asarray(ob)[:, 0]))
+    return agree / max(1, n)
+
+
+def eval_images(n: int = 256, size: int = 24, seed: int = 0,
+                dtype="uint8"):
+    """THE pinned synthetic eval set (seeded, shared by the CI gate,
+    bench_serve's ladder gate, and the tests): uniform uint8 images —
+    deterministic across machines, no dataset dependency."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, size, size, 3)).astype(dtype)

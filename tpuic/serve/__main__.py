@@ -250,6 +250,13 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
             sla = {f: req[f] for f in ("priority", "deadline_ms", "tenant")
                    if req.get(f) is not None}
             sla.setdefault("timeout", 0)
+        if req.get("serve_dtype") is not None:
+            # Dtype-ladder rung selection (docs/performance.md,
+            # "Quantized serving").  The request key is serve_dtype —
+            # NOT "dtype", which the b64 array payload already uses for
+            # the ARRAY's element type (wire.py).  An unconfigured rung
+            # gets a typed error line via the ValueError arm below.
+            sla["dtype"] = str(req["serve_dtype"])
         try:
             st["pending"].append((rid, engine.submit(img, **sla)))
         except (AdmissionError, ValueError, TypeError) as e:
@@ -393,6 +400,54 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
     return served
 
 
+def _parse_dtypes(spec: str):
+    """--serve-dtypes 'fp32,bf16,int8' -> validated ladder tags (fp32
+    always present and always the default rung)."""
+    from tpuic.quant import DTYPE_TAGS
+    tags = [t.strip() for t in (spec or "fp32").split(",") if t.strip()]
+    for t in tags:
+        if t not in DTYPE_TAGS:
+            raise SystemExit(f"serve: --serve-dtypes: unknown dtype {t!r} "
+                             f"(supported: {', '.join(DTYPE_TAGS)})")
+    if "fp32" not in tags:
+        tags.insert(0, "fp32")
+    return tuple(dict.fromkeys(tags))
+
+
+def _ladder_variants(model, variables, tags, size, *, mean, std, log):
+    """Build the quantized rungs + run the accuracy gate (docs/
+    performance.md, "Quantized serving"): a rung whose top-1 agreement
+    with fp32 on the pinned synthetic eval set falls below the
+    committed epsilon is REFUSED at startup — a quantization bug must
+    fail the server loudly, not silently serve degraded predictions."""
+    import jax
+
+    from tpuic import quant
+    variants = quant.serve_variants(model, variables, tags,
+                                    normalize=True, mean=mean, std=std)
+    if len(tags) > 1:
+        imgs = quant.eval_images(128, size)
+        ref_fwd, ref_vars = variants["fp32"]
+        ref = jax.jit(ref_fwd)
+        floor = 1.0 - quant.DEFAULT_EPSILON
+        for tag in tags:
+            if tag == "fp32":
+                continue
+            fwd, qv = variants[tag]
+            agree = quant.top1_agreement(ref, ref_vars, jax.jit(fwd), qv,
+                                         imgs)
+            if agree < floor:
+                raise SystemExit(
+                    f"serve: dtype ladder rung {tag!r} FAILED the "
+                    f"accuracy gate: top-1 agreement with fp32 is "
+                    f"{agree:.4f} < {floor:.4f} on the pinned eval set "
+                    f"(epsilon {quant.DEFAULT_EPSILON}) — refusing to "
+                    "serve a quantization that moves predictions")
+            log(f"dtype ladder rung {tag}: top-1 agreement "
+                f"{agree:.4f} >= {floor:.4f} (accuracy gate OK)")
+    return variants
+
+
 def build_engine(args):
     """Checkpoint -> warmed InferenceEngine (shared predict loading rules)."""
     if args.compile_cache_dir:
@@ -432,14 +487,21 @@ def build_engine(args):
             jax.random.key(0),
             jnp.zeros((1, resize, resize, 3), jnp.float32), train=False)
         dc = DataConfig(data_dir=".", resize_size=resize)
+        tags = _parse_dtypes(getattr(args, "serve_dtypes", "fp32"))
+        variants = _ladder_variants(
+            model, variables, tags, resize, mean=dc.mean, std=dc.std,
+            log=lambda m: print("[serve]", m, file=sys.stderr))
         engine = InferenceEngine(
-            model, variables, image_size=resize, input_dtype=np.uint8,
-            normalize=True, mean=dc.mean, std=dc.std,
+            forward_fn=variants["fp32"][0], variables=variants["fp32"][1],
+            image_size=resize, input_dtype=np.uint8,
             buckets=tuple(int(b) for b in args.buckets.split(",")),
-            max_wait_ms=args.max_wait_ms, queue_size=args.queue_size)
+            max_wait_ms=args.max_wait_ms, queue_size=args.queue_size,
+            variants={k: v for k, v in variants.items() if k != "fp32"})
         t = engine.warmup()
+        n_exe = sum(len(v) if isinstance(v, dict) else 1
+                    for v in t.values())
         print(f"[serve] synthetic init ({args.model}); warmup compiled "
-              f"{len(t)} bucket executables: {t}", file=sys.stderr)
+              f"{n_exe} bucket executables: {t}", file=sys.stderr)
         return engine, resize, args.num_classes, args.model
 
     model_name, num_classes, resize = args.model, args.num_classes, args.resize
@@ -484,14 +546,23 @@ def build_engine(args):
                                                     file=sys.stderr))
     buckets = tuple(int(b) for b in args.buckets.split(","))
     # Raw uint8 in, normalize fused into the compiled forward (4x less
-    # H2D than shipping float32 — the device_prep lesson).
+    # H2D than shipping float32 — the device_prep lesson).  The dtype
+    # ladder (--serve-dtypes) adds bf16/int8 weight rungs behind the
+    # startup accuracy gate; request lines select one with "dtype".
+    tags = _parse_dtypes(getattr(args, "serve_dtypes", "fp32"))
+    variants = _ladder_variants(
+        model, variables, tags, resize, mean=cfg.data.mean,
+        std=cfg.data.std,
+        log=lambda m: print("[serve]", m, file=sys.stderr))
     engine = InferenceEngine(
-        model, variables, image_size=resize, input_dtype=np.uint8,
-        normalize=True, mean=cfg.data.mean, std=cfg.data.std,
+        forward_fn=variants["fp32"][0], variables=variants["fp32"][1],
+        image_size=resize, input_dtype=np.uint8,
         buckets=buckets, max_wait_ms=args.max_wait_ms,
-        queue_size=args.queue_size)
+        queue_size=args.queue_size,
+        variants={k: v for k, v in variants.items() if k != "fp32"})
     t = engine.warmup()
-    print(f"[serve] warmup compiled {len(t)} bucket executables: {t}",
+    n_exe = sum(len(v) if isinstance(v, dict) else 1 for v in t.values())
+    print(f"[serve] warmup compiled {n_exe} bucket executables: {t}",
           file=sys.stderr)
     return engine, resize, num_classes, model_name
 
@@ -509,6 +580,16 @@ def main(argv=None) -> int:
                    help="torch checkpoint instead of a tpuic one")
     p.add_argument("--buckets", default="1,8,32,128",
                    help="padding-bucket ladder (comma list)")
+    p.add_argument("--serve-dtypes", default="fp32",
+                   help="dtype ladder (comma list of fp32,bf16,int8): "
+                        "per-dtype AOT executables share the bucket "
+                        "cache; bf16 halves and int8 quarters weight "
+                        "HBM (absmax per-channel, tpuic/quant). Each "
+                        "quantized rung must pass the startup top-1 "
+                        "accuracy gate vs fp32 on the pinned eval set "
+                        "or the server refuses to start. Request lines "
+                        "pick a rung with \"serve_dtype\"; default is "
+                        "fp32")
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--queue-size", type=int, default=256)
     p.add_argument("--compile-cache-dir", default="~/.cache/tpuic/xla",
@@ -948,6 +1029,13 @@ def main(argv=None) -> int:
                     sla = {k: req[k] for k in ("priority", "deadline_ms",
                                                "tenant") if req.get(k)
                            is not None}
+                if req.get("serve_dtype") is not None:
+                    # Ladder rung selection (serve_dtype, matching the
+                    # socket transport; "dtype" is the wire array
+                    # payload's element type); a typo'd rung gets a
+                    # typed error line through submit()'s ValueError
+                    # arm.
+                    sla["dtype"] = str(req["serve_dtype"])
                 submit(str(req.get("id", path)), path, **sla)
 
             # select()-gated RAW reads, not ``for line in sys.stdin``: a

@@ -107,12 +107,13 @@ class _Request:
     ``time.monotonic()`` reads — no device interaction, ever."""
 
     __slots__ = ("images", "n", "future", "trace", "priority", "pidx",
-                 "tenant", "deadline", "t_enqueue", "t_gather")
+                 "tenant", "deadline", "t_enqueue", "t_gather", "variant")
 
     def __init__(self, images: np.ndarray, future: Future,
                  trace: int = 0, priority: str = DEFAULT_PRIORITY,
                  tenant: Optional[str] = None,
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 variant: str = "fp32") -> None:
         self.images = images
         self.n = images.shape[0]
         self.future = future
@@ -120,6 +121,7 @@ class _Request:
         self.priority = priority
         self.pidx = priority_index(priority)
         self.tenant = tenant
+        self.variant = variant
         self.t_enqueue = time.monotonic()
         self.t_gather = self.t_enqueue  # stamped when the batcher pops it
         # Absolute monotonic deadline; None = the caller waits forever.
@@ -241,7 +243,9 @@ class InferenceEngine:
                  max_wait_ms: float = 5.0, queue_size: int = 256,
                  normalize: bool = False, mean=None, std=None,
                  forward_fn=None, stats: Optional[ServeStats] = None,
-                 admission=None, autostart: bool = True) -> None:
+                 admission=None, variants: Optional[dict] = None,
+                 default_variant: str = "fp32",
+                 autostart: bool = True) -> None:
         import jax
 
         if not buckets:
@@ -260,6 +264,22 @@ class InferenceEngine:
         # One up-front transfer (predict.py's lesson): host leaves would be
         # re-uploaded on every executable call.
         self._variables = jax.device_put(variables)
+        # Dtype ladder (docs/performance.md, "Quantized serving"): the
+        # default variant is (forward, variables) above under
+        # ``default_variant``; ``variants`` adds named alternates — e.g.
+        # tpuic.quant.serve_variants' bf16/int8 weight representations —
+        # each with its OWN forward + variables but sharing the bucket
+        # ladder, queue, and batcher.  Executables are keyed
+        # (variant, bucket) into the one AOT cache, so the zero
+        # steady-state-compile contract holds per rung.
+        self.default_variant = str(default_variant)
+        self._variants = {self.default_variant: (self._forward,
+                                                 self._variables)}
+        for tag, (fwd, vs) in (variants or {}).items():
+            tag = str(tag)
+            if tag == self.default_variant:
+                continue  # the constructor pair IS the default rung
+            self._variants[tag] = (fwd, jax.device_put(vs))
         self._executables = {}
         self._compile_lock = threading.Lock()
         self._jax = jax
@@ -333,35 +353,43 @@ class InferenceEngine:
                          f"{self.max_batch}")
 
     def warmup(self) -> dict:
-        """AOT-compile every bucket's executable; returns {bucket: secs}.
+        """AOT-compile every (variant, bucket) executable.
 
-        After this, a request stream of any size mix in 1..max_batch
-        performs ZERO further lowerings — the steady-state contract.  Per
-        (model, bucket) pair the HLO also lands in the persistent XLA
-        compilation cache when one is configured, so the *next* process
-        warms up from disk."""
-        timings = {}
-        for b in self.buckets:
-            t0 = time.perf_counter()
-            self._compile(b)
-            timings[b] = round(time.perf_counter() - t0, 3)
-        return timings
+        Returns ``{bucket: secs}`` for a single-variant engine (the
+        historical shape) or ``{variant: {bucket: secs}}`` for a dtype
+        ladder.  After this, a request stream of any size mix in
+        1..max_batch on any configured variant performs ZERO further
+        lowerings — the steady-state contract, per rung.  Per
+        (model, variant, bucket) the HLO also lands in the persistent
+        XLA compilation cache when one is configured, so the *next*
+        process warms up from disk."""
+        per_variant = {}
+        for tag in self._variants:
+            timings = {}
+            for b in self.buckets:
+                t0 = time.perf_counter()
+                self._compile(tag, b)
+                timings[b] = round(time.perf_counter() - t0, 3)
+            per_variant[tag] = timings
+        if len(per_variant) == 1:
+            return per_variant[self.default_variant]
+        return per_variant
 
-    def _compile(self, bucket: int):
+    def _compile(self, variant: str, bucket: int):
         # Serialized: warmup() (caller thread) and the batcher's lazy
         # fallback may race on the same bucket; without the lock both
         # would compile it and the compiles-flat contract would report
         # phantom recompiles.
         with self._compile_lock:
-            exe = self._executables.get(bucket)
+            exe = self._executables.get((variant, bucket))
             if exe is not None:
                 return exe
+            forward, variables = self._variants[variant]
             spec = self._jax.ShapeDtypeStruct(
                 (bucket, self.image_size, self.image_size, self.channels),
                 self.input_dtype)
             t0 = time.perf_counter()
-            exe = self._jax.jit(self._forward).lower(
-                self._variables, spec).compile()
+            exe = self._jax.jit(forward).lower(variables, spec).compile()
             self.stats.record_compile(bucket, time.perf_counter() - t0)
             # Roofline context where the runtime exposes it: the
             # AOT-lowered executable's FLOPs/bytes per call
@@ -377,7 +405,7 @@ class InferenceEngine:
                                                     0.0)))
             except Exception:
                 pass
-            self._executables[bucket] = exe
+            self._executables[(variant, bucket)] = exe
             return exe
 
     def profile_waterfall(self):
@@ -394,10 +422,16 @@ class InferenceEngine:
                                                  hbm_bandwidth, peak_flops)
             from tpuic.telemetry.profile import (attribute_device_time,
                                                  hlo_waterfall)
-            bucket = max(self._executables)
+            # Largest warmed bucket of the DEFAULT variant (fall back to
+            # any variant when only an alternate rung has compiled).
+            keys = [k for k in self._executables
+                    if k[0] == self.default_variant] or \
+                list(self._executables)
+            key = max(keys, key=lambda k: k[1])
+            bucket = key[1]
             cached = getattr(self, "_profile_model_wf", None)
             if cached is None or cached.get("bucket") != bucket:
-                exe = self._executables[bucket]
+                exe = self._executables[key]
                 try:
                     cost = cost_analysis_dict(exe)
                 except Exception:
@@ -422,13 +456,13 @@ class InferenceEngine:
         except Exception:
             return None
 
-    def _executable_for(self, bucket: int):
-        exe = self._executables.get(bucket)
+    def _executable_for(self, variant: str, bucket: int):
+        exe = self._executables.get((variant, bucket))
         if exe is None:
             # Lazy fallback so an un-warmed engine still works; counted,
             # so the compile-flat-after-warmup test catches any batcher
             # path that would hit this in steady state.
-            return self._compile(bucket)
+            return self._compile(variant, bucket)
         self.stats.record_cache_hit()
         return exe
 
@@ -436,7 +470,8 @@ class InferenceEngine:
     def submit(self, images, *, timeout: Optional[float] = None,
                priority: str = DEFAULT_PRIORITY,
                deadline_ms: Optional[float] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None,
+               dtype: Optional[str] = None) -> Future:
         """Enqueue [n,S,S,C] (or one [S,S,C] row) for inference.
 
         Returns a Future resolving to the forward's pytree sliced to this
@@ -486,6 +521,13 @@ class InferenceEngine:
         # malformed deadline failing after admit() would have consumed a
         # quota token for a request that never enters the ledger.
         priority_index(priority)
+        # Dtype-ladder routing: None rides the default rung; a named
+        # rung must exist — serving fp32 under a typo'd 'int8' label
+        # would silently void the accuracy-gate contract.
+        variant = self.default_variant if dtype is None else str(dtype)
+        if variant not in self._variants:
+            raise ValueError(f"unknown serve dtype {variant!r}; "
+                             f"configured: {sorted(self._variants)}")
         if deadline_ms is not None:
             deadline_ms = float(deadline_ms)  # tpuic-ok: TPU101 SLA fields are host scalars by contract
         if self.admission is not None:
@@ -500,7 +542,7 @@ class InferenceEngine:
         fut: Future = Future()
         req = _Request(arr, fut, trace=next(self._traces),
                        priority=priority, tenant=tenant,
-                       deadline_ms=deadline_ms)
+                       deadline_ms=deadline_ms, variant=variant)
         # Caller-side correlation handle: a driver logging an error line
         # can name the same trace id the span ledger carries.
         fut.tpuic_trace = req.trace
@@ -606,6 +648,13 @@ class InferenceEngine:
             if rows + nxt.n > self.max_batch:
                 self._held = nxt
                 break
+            if nxt.variant != first.variant:
+                # A device batch runs ONE (variant, bucket) executable,
+                # so a dtype-ladder boundary closes the batch the same
+                # way an overflow does: the mismatched request is held
+                # and LEADS the next batch (held work is never starved).
+                self._held = nxt
+                break
             reqs.append(nxt)
             rows += nxt.n
         return reqs
@@ -667,8 +716,9 @@ class InferenceEngine:
                 1.0 if hang_s is None else float(hang_s))  # tpuic-ok: TPU101 fault param is a host float
         self.stats.record_dispatch(bucket, rows,
                                    [t_staged - r.t_enqueue for r in reqs])
-        exe = self._executable_for(bucket)
-        out = exe(self._variables, self._jax.device_put(batch))
+        variant = reqs[0].variant  # _gather guarantees a pure batch
+        exe = self._executable_for(variant, bucket)
+        out = exe(self._variants[variant][1], self._jax.device_put(batch))
         # Async dispatch: the call returns once work is ENQUEUED; the
         # stamp closes the dispatch span, device time accrues until the
         # readback in _resolve.
@@ -707,7 +757,7 @@ class InferenceEngine:
         # the in-band record of what the micro-batcher decided, published
         # from the batcher thread (the bus is thread-safe; idle = free).
         _tm_publish("serve_batch", bucket=int(bucket), requests=len(reqs),
-                    images=int(valid),
+                    images=int(valid), variant=reqs[0].variant,
                     latency_ms=round(1000.0 * max(latencies), 3))
         # Span events are per REQUEST — only build the dicts when someone
         # is listening (the bus's active() check keeps an unobserved

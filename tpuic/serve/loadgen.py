@@ -77,6 +77,24 @@ def probe_unbatched_rps(engine, reqs: Sequence,
     return 1.0 / service_s, service_s, probe_raw_s, stall_s
 
 
+def probe_batched_rps(engine, reqs: Sequence, probe_n: int = 400) -> float:
+    """Full-batching burst-capacity probe: offer a burst as fast as
+    possible (run_stream, no pacing) and return requests/sec.
+
+    The OTHER half of the dual anchor (docs/serving.md): micro-batching
+    lets the engine sustain several times the unbatched rate, so an
+    overload drive anchored only to ``probe_unbatched_rps`` can sit
+    BELOW true capacity on a fast machine.  The CI overload soak and
+    bench_serve both record it next to the unbatched probe, so a
+    container-speed wobble in the committed knee is diagnosable from
+    the artifact instead of silently absorbed.  Resets ``engine.stats``
+    (via run_stream)."""
+    n = max(1, min(int(probe_n), len(reqs)))
+    t0 = time.perf_counter()
+    run_stream(engine, reqs[:n])
+    return n / max(time.perf_counter() - t0, 1e-9)
+
+
 def run_stream(engine, reqs: Sequence, *,
                offsets_s: Optional[Sequence[float]] = None,
                result_timeout_s: float = 600.0,

@@ -171,7 +171,13 @@ def check_flops_drift(model_name: str, image_size: int, global_batch: int,
 # fallback formula (3 * 2 * 4.1e9 * B / 2 for resnet50@224), which
 # tests/test_telemetry.py pins as the golden value.
 FWD_FLOPS_PER_IMAGE = {
-    "resnet18-cifar": (0.56e9, 32),
+    # 1.11e9 = 2 * 0.56 GMACs: the CIFAR-ResNet18 literature figure is
+    # MACs, and the table is FLOPs (2 per MAC).  The original 0.56e9
+    # entry was the MAC count pasted as FLOPs — PR 10's
+    # check_flops_drift surfaced it as a 43% drift vs the compiler's
+    # count (compiled fwd ~1.04e9/img at 32px); at 1.11e9 the drift is
+    # ~7%, inside the 10% warning threshold the profile smoke asserts.
+    "resnet18-cifar": (1.11e9, 32),
     "resnet18": (1.82e9, 224),
     "resnet34": (3.67e9, 224),
     "resnet50": (4.1e9, 224),

@@ -75,6 +75,15 @@ METRIC_SPECS = {
     "train.accounted_frac":   ("higher", "ratio", 0.05),
     "serve.latency_p50_ms":   ("lower", "time", 0.70),
     "serve.latency_p99_ms":   ("lower", "time", 1.00),
+    # The quantized serve ladder's latency rows (docs/performance.md,
+    # "Quantized serving"): a regression in a bf16/int8 rung — a
+    # dequant fusion lost, a per-dtype executable falling out of the
+    # AOT cache — must fail CI even when the fp32 rung stays fast.
+    # Compiles of every rung fold into serve.steady_compiles (exact).
+    "serve.bf16_latency_p50_ms": ("lower", "time", 0.70),
+    "serve.bf16_latency_p99_ms": ("lower", "time", 1.00),
+    "serve.int8_latency_p50_ms": ("lower", "time", 0.70),
+    "serve.int8_latency_p99_ms": ("lower", "time", 1.00),
     "serve.throughput_images_per_sec": ("higher", "rate", 0.50),
     "serve.pad_efficiency":   ("higher", "ratio", 0.20),
     "serve.steady_compiles":  ("lower", "count", 0.0),
@@ -186,16 +195,23 @@ def serve_workload(requests: int = 48, *, size: int = 16,
     at HALF that throughput measures latency/pad efficiency — pacing
     relative to the machine's own capacity keeps the latency numbers
     comparable across machine speeds (the calibration scale covers the
-    rest).  ``forward_fn`` overrides the default small-model forward
-    (tests use a stub to stay fast)."""
+    rest).  The real-model path then repeats the paced pass once per
+    quantized ladder rung (bf16/int8 via tpuic.quant) for the
+    ``serve.<dtype>_latency_*`` rows, with every rung's compiles folded
+    into the exact ``serve.steady_compiles`` counter.  ``forward_fn``
+    overrides the default small-model forward (tests use a stub to stay
+    fast — the stub path skips the ladder rows, which then compare as
+    'missing' rather than regressed)."""
     import numpy as np
 
     from tpuic.serve import InferenceEngine, loadgen
 
+    variants = {}
     if forward_fn is None:
         import jax
         import jax.numpy as jnp
 
+        from tpuic import quant
         from tpuic.models import create_model
         from tpuic.serve import make_forward
         model = create_model("resnet18-cifar", 10, dtype="float32")
@@ -203,6 +219,9 @@ def serve_workload(requests: int = 48, *, size: int = 16,
                                jnp.zeros((1, size, size, 3), jnp.float32),
                                train=False)
         forward, fwd_vars = make_forward(model, normalize=True), variables
+        variants = {k: v for k, v in quant.serve_variants(
+            model, variables, ("fp32", "bf16", "int8"),
+            normalize=True).items() if k != "fp32"}
     else:
         forward, fwd_vars = forward_fn, {}
     rng = np.random.default_rng(seed)
@@ -212,16 +231,19 @@ def serve_workload(requests: int = 48, *, size: int = 16,
     engine = InferenceEngine(
         forward_fn=forward, variables=fwd_vars, image_size=size,
         input_dtype=np.uint8, buckets=tuple(buckets),
-        max_wait_ms=max_wait_ms, queue_size=max(64, requests))
+        max_wait_ms=max_wait_ms, queue_size=max(64, requests),
+        variants=variants)
     try:
         engine.warmup()
 
-        def run(rate: float) -> dict:
+        def run(rate: float, dtype=None) -> dict:
             # The shared bench/gate driver (tpuic/serve/loadgen.py): the
             # gate measures with exactly the harness bench_serve.py uses.
             offsets = ([i / rate for i in range(len(reqs))]
                        if rate > 0 else None)
-            wall, _, snap = loadgen.run_stream(engine, reqs,
+            items = (reqs if dtype is None
+                     else [(r, {"dtype": dtype}) for r in reqs])
+            wall, _, snap = loadgen.run_stream(engine, items,
                                                offsets_s=offsets)
             snap["_wall_s"] = wall
             return snap
@@ -234,13 +256,21 @@ def serve_workload(requests: int = 48, *, size: int = 16,
         # stats.reset() zeroes the compile counter per pass, so this is
         # exactly "executables built AFTER warmup" — the AOT contract.
         steady_compiles = fast["compiles"] + paced["compiles"]
-        return {
+        out = {
             "serve.latency_p50_ms": float(paced["latency_ms"]["p50"]),
             "serve.latency_p99_ms": float(paced["latency_ms"]["p99"]),
             "serve.throughput_images_per_sec": round(throughput, 2),
             "serve.pad_efficiency": float(paced["pad_efficiency"]),
-            "serve.steady_compiles": float(steady_compiles),
         }
+        for tag in sorted(variants):
+            rung = run(paced_rate, dtype=tag)
+            steady_compiles += rung["compiles"]
+            out[f"serve.{tag}_latency_p50_ms"] = \
+                float(rung["latency_ms"]["p50"])
+            out[f"serve.{tag}_latency_p99_ms"] = \
+                float(rung["latency_ms"]["p99"])
+        out["serve.steady_compiles"] = float(steady_compiles)
+        return out
     finally:
         engine.close()
 

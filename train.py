@@ -107,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the train-fold rot90/flip/jitter chain "
                         "(orientation-sensitive datasets, e.g. digits); "
                         "normalization and val behavior are unchanged")
+    p.add_argument("--no-native", action="store_true",
+                   help="disable the native C++ decode/prep core "
+                        "(tpuic/native) and run the pure-NumPy input "
+                        "path — the parity reference the native "
+                        "kernels are pinned against")
     p.add_argument("--no-pack", action="store_true",
                    help="disable the packed uint8 cache + device-side "
                         "augmentation; decode every epoch like the reference")
@@ -249,6 +254,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         device_cache_mb=args.device_cache_mb,
                         pack=not args.no_pack, cache_dir=args.cache_dir,
                         augment=not args.no_augment,
+                        native=not args.no_native,
                         quarantine=not args.no_quarantine),
         model=ModelConfig(name=args.model, num_classes=args.num_classes,
                           dtype=args.dtype, attention=args.attention,
