@@ -1,0 +1,391 @@
+"""Swap-time admission gates (docs/serving.md, "Model lifecycle"):
+the strict candidate loader, the swap_corrupt/swap_accuracy refusal
+verdicts, concurrent reload safety, and the swap control line over the
+socket transport.
+
+The contract under test: a hot-swap CANDIDATE reaches traffic only
+through the CRC/manifest integrity gate (no ladder fallback — the
+operator's named rung or nothing) and the pinned-eval accuracy gate,
+and a refused candidate leaves the incumbent serving bit-identical
+weights.  The full fleet lifecycle is CI's ``scripts/rollout_soak.py``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpuic.checkpoint.loading import (load_candidate_variables,
+                                      variables_digest)
+from tpuic.checkpoint.manager import CheckpointManager
+from tpuic.config import (Config, DataConfig, ModelConfig, OptimConfig,
+                          RunConfig)
+from tpuic.models import create_model
+from tpuic.runtime import faults
+from tpuic.serve import InferenceEngine, make_forward
+from tpuic.serve.admission import SwapRejected
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+
+MODEL, CLASSES, SIZE = "resnet18-cifar", 10, 24
+OCFG = OptimConfig(optimizer="adam", learning_rate=1e-3,
+                   class_weights=(), milestones=())
+
+
+def _cfg(ckpt_dir) -> Config:
+    return Config(
+        data=DataConfig(data_dir=".", resize_size=SIZE),
+        model=ModelConfig(name=MODEL, num_classes=CLASSES),
+        optim=OCFG,
+        run=RunConfig(ckpt_dir=str(ckpt_dir)))
+
+
+def _state(seed=0, poison_nan=False):
+    model = create_model(MODEL, CLASSES, dtype="float32")
+    state = create_train_state(model, make_optimizer(OCFG),
+                               jax.random.key(seed),
+                               (1, SIZE, SIZE, 3))
+    if poison_nan:
+        # NaN-poisoned kernels: the shape of corruption CRC can NOT
+        # catch (the manifest records exactly what was written) — only
+        # the pinned-eval accuracy gate can.
+        state = state.replace(params=jax.tree.map(
+            lambda a: a * np.nan if a.ndim >= 2 else a, state.params))
+    return state
+
+
+def _commit(ckpt_dir, seed=0, poison_nan=False) -> CheckpointManager:
+    mgr = CheckpointManager(str(ckpt_dir), MODEL)
+    mgr.save_latest(_state(seed, poison_nan), epoch=0, best_score=0.0)
+    mgr.wait()  # commit: manifest sidecar + rotation
+    return mgr
+
+
+def _payload_files(track_dir):
+    out = []
+    for dirpath, _, files in os.walk(track_dir):
+        out.extend(os.path.join(dirpath, f) for f in files)
+    return sorted(out, key=os.path.getsize, reverse=True)
+
+
+# -- the strict candidate loader ---------------------------------------------
+def test_candidate_load_roundtrip_and_digest(tmp_path):
+    _commit(tmp_path)
+    model, variables, digest = load_candidate_variables(
+        _cfg(tmp_path), track="latest", log=lambda *a: None)
+    assert digest == variables_digest(variables)
+    assert len(digest) == 8
+    # Same weights through the boot loader agree on identity.
+    from tpuic.checkpoint.loading import load_inference_variables
+    _, boot_vars = load_inference_variables(
+        _cfg(tmp_path), track="latest", log=lambda *a: None)
+    assert variables_digest(boot_vars) == digest
+
+
+def test_candidate_missing_track_is_typed_refusal(tmp_path):
+    with pytest.raises(SwapRejected) as ei:
+        load_candidate_variables(_cfg(tmp_path), track="latest",
+                                 log=lambda *a: None)
+    assert ei.value.cause == "swap_corrupt"
+
+
+def test_candidate_corrupt_bytes_refused(tmp_path):
+    _commit(tmp_path)
+    victim = _payload_files(tmp_path / MODEL / "latest")[0]
+    faults.corrupt_file(victim)
+    with pytest.raises(SwapRejected) as ei:
+        load_candidate_variables(_cfg(tmp_path), track="latest",
+                                 log=lambda *a: None)
+    assert ei.value.cause == "swap_corrupt"
+    assert "checksum mismatch" in str(ei.value)
+
+
+def test_candidate_without_manifest_refused(tmp_path):
+    _commit(tmp_path)
+    os.remove(tmp_path / MODEL / "latest.manifest.json")
+    with pytest.raises(SwapRejected) as ei:
+        load_candidate_variables(_cfg(tmp_path), track="latest",
+                                 log=lambda *a: None)
+    assert ei.value.cause == "swap_corrupt"
+    assert "manifest" in str(ei.value)
+
+
+def test_swap_corrupt_fault_point_fires_at_the_gate(tmp_path):
+    """The registered fault point: a PRISTINE artifact is corrupted
+    between locate and verify — the CRC gate must catch its own
+    injected rot (runtime/faults.py 'swap_corrupt')."""
+    _commit(tmp_path)
+    faults.reset()
+    faults.arm("swap_corrupt", times=1)
+    try:
+        with pytest.raises(SwapRejected) as ei:
+            load_candidate_variables(_cfg(tmp_path), track="latest",
+                                     log=lambda *a: None)
+        assert ei.value.cause == "swap_corrupt"
+        assert faults.fired("swap_corrupt") == 1
+    finally:
+        faults.reset()
+
+
+def test_candidate_loader_never_ladders_to_prev(tmp_path):
+    """restore_into falls back newest -> .prev on corruption (right for
+    a crashed trainer); the SWAP loader must refuse instead — silently
+    flipping the previous rotation into traffic serves weights the
+    operator never named."""
+    mgr = _commit(tmp_path, seed=0)
+    mgr.save_latest(_state(seed=1), epoch=1, best_score=0.0)
+    mgr.wait()  # seed-0 save rotated to latest.prev (intact)
+    victim = _payload_files(tmp_path / MODEL / "latest")[0]
+    faults.corrupt_file(victim)
+    # Trainer path: ladders to the intact .prev rung and restores.
+    restored, _, _ = CheckpointManager(str(tmp_path), MODEL).restore_into(
+        _state(seed=3), track="latest")
+    assert restored is not None
+    # Swap path: typed refusal, no fallback.
+    with pytest.raises(SwapRejected) as ei:
+        load_candidate_variables(_cfg(tmp_path), track="latest",
+                                 log=lambda *a: None)
+    assert ei.value.cause == "swap_corrupt"
+
+
+# -- concurrent reload -------------------------------------------------------
+def _serving_engine():
+    model = create_model(MODEL, CLASSES, dtype="float32")
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, SIZE, SIZE, 3), np.float32),
+                           train=False)
+    eng = InferenceEngine(
+        forward_fn=make_forward(model, normalize=True),
+        variables=variables, image_size=SIZE, input_dtype=np.uint8,
+        buckets=(1, 2), max_wait_ms=1.0)
+    eng.warmup()
+    return model, eng
+
+
+def test_concurrent_reload_never_touches_the_incumbent(tmp_path):
+    """Load a candidate while the incumbent serves: the incumbent's
+    variables stay bit-identical, in-flight traffic resolves, and a
+    FAILED (corrupt-rung) load leaves the engine serving untouched."""
+    _commit(tmp_path, seed=7)
+    _, eng = _serving_engine()
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (64, SIZE, SIZE, 3), np.uint8)
+    incumbent_digest = eng.model_digest
+    before = [np.array(x) for x in jax.tree_util.tree_leaves(
+        eng._variants["fp32"][1])]
+    stop = threading.Event()
+    futs = []
+
+    def stream():
+        i = 0
+        while not stop.is_set():
+            futs.append(eng.submit(imgs[i % 64][None]))
+            i += 1
+            time.sleep(0.002)
+
+    t = threading.Thread(target=stream, daemon=True)
+    t.start()
+    try:
+        _, cand_vars, cand_digest = load_candidate_variables(
+            _cfg(tmp_path), track="latest", log=lambda *a: None)
+        assert cand_digest != incumbent_digest
+        # Now a corrupt-rung load mid-serve: typed refusal, no fallout.
+        faults.corrupt_file(
+            _payload_files(tmp_path / MODEL / "latest")[0])
+        with pytest.raises(SwapRejected):
+            load_candidate_variables(_cfg(tmp_path), track="latest",
+                                     log=lambda *a: None)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    for f in futs:
+        f.result(timeout=30)  # nothing dropped, nothing errored
+    assert eng.model_digest == incumbent_digest
+    after = [np.array(x) for x in jax.tree_util.tree_leaves(
+        eng._variants["fp32"][1])]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    eng.close()
+
+
+# -- the accuracy gate (run_swap) --------------------------------------------
+def _ctx_engine(tmp_path, tags=("fp32",)):
+    from tpuic.serve.__main__ import _swap_context
+    model, eng = _serving_engine()
+    _swap_context(eng, model=model, model_name=MODEL,
+                  num_classes=CLASSES, resize=SIZE, tags=tags,
+                  mean=None, std=None, ckpt_dir=str(tmp_path),
+                  track="latest")
+    return eng
+
+
+def test_swap_accuracy_gate_refuses_nan_candidate(tmp_path):
+    """A checkpoint whose bytes verify (the manifest records what was
+    written) but whose weights produce garbage: only the pinned-eval
+    gate can catch it, with the swap_accuracy verdict — and the
+    incumbent keeps serving."""
+    from tpuic.serve.__main__ import run_swap
+    _commit(tmp_path, poison_nan=True)
+    eng = _ctx_engine(tmp_path)
+    try:
+        d0 = eng.model_digest
+        with pytest.raises(SwapRejected) as ei:
+            run_swap(eng, {"op": "swap", "ckpt_dir": str(tmp_path),
+                           "track": "latest"}, lambda m: None)
+        assert ei.value.cause == "swap_accuracy"
+        assert "non-finite" in str(ei.value)
+        assert eng.model_digest == d0 and eng.generation == 0
+        eng.predict(np.zeros((1, SIZE, SIZE, 3), np.uint8))
+    finally:
+        eng.close()
+
+
+def test_swap_accuracy_gate_refuses_disagreeing_ladder_rung(
+        tmp_path, monkeypatch):
+    """The PR-13 startup gate re-run per swap: a quantization path that
+    breaks (rung disagreeing with the candidate's own fp32) refuses the
+    WHOLE swap — the ladder flips as one unit or not at all."""
+    from tpuic import quant
+    from tpuic.serve.__main__ import run_swap
+    _commit(tmp_path, seed=5)
+    model = create_model(MODEL, CLASSES, dtype="float32")
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, SIZE, SIZE, 3), np.float32),
+                           train=False)
+    variants = quant.serve_variants(model, variables, ("fp32", "int8"),
+                                    normalize=True)
+    eng = InferenceEngine(
+        forward_fn=variants["fp32"][0], variables=variants["fp32"][1],
+        image_size=SIZE, input_dtype=np.uint8, buckets=(1, 2),
+        max_wait_ms=1.0, variants={"int8": variants["int8"]})
+    eng.warmup()
+    from tpuic.serve.__main__ import _swap_context
+    _swap_context(eng, model=model, model_name=MODEL,
+                  num_classes=CLASSES, resize=SIZE,
+                  tags=("fp32", "int8"), mean=None, std=None,
+                  ckpt_dir=str(tmp_path), track="latest")
+    real_quantize = quant.quantize_variables
+    monkeypatch.setattr(
+        quant, "quantize_variables",
+        lambda v: real_quantize(quant.corrupt_variables(v)))
+    try:
+        with pytest.raises(SwapRejected) as ei:
+            run_swap(eng, {"op": "swap", "ckpt_dir": str(tmp_path),
+                           "track": "latest"}, lambda m: None)
+        assert ei.value.cause == "swap_accuracy"
+        assert "int8" in str(ei.value)
+        assert eng.generation == 0  # nothing flipped
+    finally:
+        eng.close()
+
+
+# -- swap over the socket transport ------------------------------------------
+def test_socket_swap_end_to_end(tmp_path):
+    """A swap control line over the replica transport: gate + flip on a
+    worker thread (pings keep answering), swap_result keyed by id, and
+    the NEXT pong reports the candidate's digest — exactly the signal
+    the router's identity gate and the rollout driver consume."""
+    from test_serve import _FakeGuard, _sock_request
+    from tpuic.serve.__main__ import serve_socket
+
+    ckpt = tmp_path / "cp"
+    _commit(ckpt, seed=9)
+    eng = _ctx_engine(ckpt)
+    guard = _FakeGuard()
+    ready_file = str(tmp_path / "ready.json")
+    t = threading.Thread(
+        target=serve_socket, daemon=True,
+        kwargs=dict(engine=eng, listen="127.0.0.1:0",
+                    names={i: str(i) for i in range(CLASSES)},
+                    top_k=1, size=SIZE, guard=guard, beat=lambda: None,
+                    drain_timeout=5.0, ready_file=ready_file,
+                    log=lambda m: None))
+    t.start()
+    from tpuic.serve import wire
+    deadline = time.monotonic() + 10.0
+    ready = None
+    while time.monotonic() < deadline and ready is None:
+        ready = wire.read_ready_file(ready_file)
+        time.sleep(0.01)
+    assert ready is not None
+    port = int(ready["port"])
+    try:
+        boot_digest = ready["digest"]
+        lines = _sock_request(
+            port, [{"op": "swap", "id": "s1"}], 1, timeout=60.0)
+        rec = lines[0]
+        assert rec.get("ok") is True and rec["id"] == "s1", rec
+        assert rec["generation"] == 1
+        assert rec["digest"] != boot_digest
+        assert rec["reused_executables"] is True  # same architecture
+        pong = _sock_request(port, [{"op": "ping", "id": "p"}], 1)[0]
+        assert pong["digest"] == rec["digest"]
+        assert pong["generation"] == 1
+        # Traffic still flows post-swap (zero-downtime end state).
+        img = np.zeros((1, SIZE, SIZE, 3), np.uint8)
+        resp = _sock_request(
+            port, [{"id": "r1", **wire.encode_array(img)}], 1,
+            timeout=30.0)[0]
+        assert resp["id"] == "r1" and "pred" in resp
+    finally:
+        guard.triggered = True
+        t.join(timeout=10.0)
+        eng.close()
+
+
+def test_stdin_swap_does_not_block_traffic(tmp_path, monkeypatch):
+    """A seconds-long swap line on the stdin transport must not
+    head-of-line block predict responses behind it: control outcomes
+    drain on their own out-of-order lane (review hardening)."""
+    import io
+
+    import jax.numpy as jnp
+    from PIL import Image
+
+    import tpuic.serve.__main__ as serve_main
+
+    rng = np.random.default_rng(3)
+    imgs_dir = tmp_path / "imgs"
+    imgs_dir.mkdir()
+    for i in range(3):
+        Image.fromarray(rng.integers(0, 256, (8, 8, 3), np.uint8)).save(
+            imgs_dir / f"im_{i}.png")
+
+    def fake_build_engine(args):
+        def fwd(variables, images):
+            s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+            probs = jax.nn.softmax(
+                jnp.stack([s, -s, jnp.zeros_like(s)], axis=-1), axis=-1)
+            return probs, jnp.argsort(-probs, axis=-1)
+        eng = InferenceEngine(forward_fn=fwd, variables={},
+                              image_size=8, input_dtype=np.uint8,
+                              buckets=(1, 2), max_wait_ms=0.0)
+        eng.warmup()
+        return eng, 8, 3, "stub"
+
+    def slow_swap(engine, req, log):
+        time.sleep(1.0)  # the checkpoint-load-sized stall
+        return {"op": "swap_result", "ok": True, "generation": 1,
+                "digest": "deadbeef", "reused_executables": True,
+                "prewarmed": 0, "duration_s": 1.0}
+
+    monkeypatch.setattr(serve_main, "build_engine", fake_build_engine)
+    monkeypatch.setattr(serve_main, "run_swap", slow_swap)
+    lines = [json.dumps({"op": "swap", "id": "s1"})] + [
+        json.dumps({"id": f"r{i}",
+                    "path": str(imgs_dir / f"im_{i}.png")})
+        for i in range(3)]
+    monkeypatch.setattr("sys.stdin", io.StringIO("\n".join(lines) + "\n"))
+    out = tmp_path / "resp.jsonl"
+    rc = serve_main.main(["--out", str(out), "--num-classes", "3"])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in out.read_text().splitlines()]
+    ids = [r["id"] for r in recs]
+    assert set(ids) == {"s1", "r0", "r1", "r2"}
+    # The swap (1 s) resolved LAST; the predicts did not wait for it.
+    assert ids.index("s1") > max(ids.index(f"r{i}") for i in range(3))
+    assert recs[ids.index("s1")]["ok"] is True

@@ -630,3 +630,238 @@ def test_serve_span_tracing_adds_zero_syncs_zero_compiles():
         eng.close()
     assert gets_on.count == gets_off.count
     assert len(ms.of("serve_span")) == 12
+
+
+# -- atomic hot-swap (docs/serving.md, "Model lifecycle") --------------------
+def test_swap_weights_zero_drain_across_flip():
+    """THE zero-drain contract, driven deterministically: a batch
+    dispatched BEFORE the flip resolves against the old weights, the
+    first batch formed AFTER the flip runs the new ones, no future is
+    dropped, and the span ledger still sums to end-to-end latency."""
+    from tpuic.serve.metrics import SPAN_PHASES
+    from tpuic.telemetry.events import MemorySink, bus
+
+    ms = MemorySink()
+    unsub = bus.subscribe(ms, kinds=("serve_span", "swap"))
+    eng = _engine(autostart=False, max_wait_ms=0.0)
+    rng = np.random.default_rng(11)
+    try:
+        eng.warmup()
+        img_a, img_b = _imgs(rng, 2), _imgs(rng, 2)
+        fut_a = eng.submit(img_a)
+        batch_a = eng._dispatch(eng._gather(0.5))  # in flight, OLD gen
+        res = eng.swap_weights({"bias": jnp.float32(100.0)})
+        assert res["reused_executables"] and res["generation"] == 1
+        fut_b = eng.submit(img_b)
+        batch_b = eng._dispatch(eng._gather(0.5))  # formed post-flip
+        eng._resolve(batch_a)
+        eng._resolve(batch_b)
+        want_a = img_a.astype(np.float64).sum(axis=(1, 2, 3))
+        want_b = img_b.astype(np.float64).sum(axis=(1, 2, 3)) + 100.0
+        np.testing.assert_allclose(np.asarray(fut_a.result(1)), want_a,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(fut_b.result(1)), want_b,
+                                   rtol=1e-4)
+    finally:
+        eng.close()
+        unsub()
+    swaps = ms.of("swap")
+    assert len(swaps) == 1 and swaps[0].data["generation"] == 1
+    assert swaps[0].data["reused_executables"] is True
+    spans = ms.of("serve_span")
+    assert len(spans) == 2  # nothing dropped, nothing re-run
+    for e in spans:
+        span_sum = sum(e.data[f"{p}_ms"] for p in SPAN_PHASES)
+        assert span_sum == pytest.approx(e.data["total_ms"], abs=0.01)
+
+
+def test_swap_weights_aval_match_is_compile_free():
+    """Hot-swapping same-shape weights reuses the AOT executable cache:
+    zero compiles across the swap AND the post-swap stream, checker-
+    asserted — the soak's compiles-flat scrape, in-process."""
+    from tpuic.analysis.runtime import assert_compiles_flat
+
+    eng = _engine(max_wait_ms=0.0)
+    rng = np.random.default_rng(12)
+    try:
+        eng.warmup()
+        eng.predict(_imgs(rng, 3))
+        before = eng.stats.snapshot()["compiles"]
+        d0 = eng.model_digest
+        with assert_compiles_flat(0, what="aval-matched hot-swap"):
+            res = eng.swap_weights({"bias": jnp.float32(7.0)})
+            for n in (1, 2, 4, 8, 3):
+                eng.predict(_imgs(rng, n))
+        assert res["reused_executables"] and res["prewarmed"] == 0
+        snap = eng.stats.snapshot()
+        assert snap["compiles"] == before
+        assert snap["generation"] == 1 and snap["swaps"] == 1
+        assert snap["model_digest"] == eng.model_digest != d0
+    finally:
+        eng.close()
+
+
+def test_swap_weights_prewarms_off_path_on_aval_mismatch():
+    """A candidate with different leaf shapes cannot reuse executables:
+    every (variant, bucket) prewarms BEFORE the flip and traffic still
+    resolves on both sides of it."""
+    eng = _engine(max_wait_ms=0.0, buckets=(1, 2))
+    rng = np.random.default_rng(13)
+    try:
+        eng.warmup()
+        eng.predict(_imgs(rng, 1))
+        # [1]-shaped bias instead of scalar: broadcast-compatible for
+        # the forward, aval-different for the executables.
+        res = eng.swap_weights({"bias": jnp.ones((1,), jnp.float32)})
+        assert not res["reused_executables"]
+        assert res["prewarmed"] == len(eng.buckets)
+        out = eng.predict(_imgs(rng, 2))
+        assert np.asarray(out).shape[-1] >= 1  # resolves on new gen
+    finally:
+        eng.close()
+
+
+def test_swap_weights_ladder_swaps_as_one_unit():
+    """A dtype-ladder engine refuses a partial swap (split-brain
+    ladder) and a full swap lands every rung's new weights."""
+    eng = _engine(
+        autostart=True, max_wait_ms=0.0,
+        variants={"alt": (_sum_forward, {"bias": jnp.float32(10.0)})})
+    rng = np.random.default_rng(14)
+    img = _imgs(rng, 1)
+    base = img.astype(np.float64).sum()
+    try:
+        eng.warmup()
+        with pytest.raises(ValueError, match="one unit"):
+            eng.swap_weights({"bias": jnp.float32(1.0)})
+        with pytest.raises(ValueError, match="one unit"):
+            eng.swap_weights({"bias": jnp.float32(1.0)},
+                             variants={"alt": {"bias": jnp.float32(2.0)},
+                                       "ghost": {"bias": jnp.float32(3.0)}})
+        res = eng.swap_weights(
+            {"bias": jnp.float32(1.0)},
+            variants={"alt": {"bias": jnp.float32(11.0)}})
+        assert res["reused_executables"]
+        got_def = float(np.asarray(eng.predict(img)))
+        got_alt = float(np.asarray(
+            eng.submit(img, dtype="alt").result(30)))
+        assert got_def == pytest.approx(base + 1.0, rel=1e-5)
+        assert got_alt == pytest.approx(base + 11.0, rel=1e-5)
+    finally:
+        eng.close()
+
+
+def test_swap_under_live_traffic_drops_nothing():
+    """Swaps mid-stream: every submitted future resolves (old or new
+    weights, never an error, never a drop) and the ledger stays exact."""
+    eng = _engine(max_wait_ms=1.0)
+    rng = np.random.default_rng(15)
+    stop = False
+    futs = []
+    try:
+        eng.warmup()
+
+        def feeder():
+            while not stop:
+                futs.append(eng.submit(_imgs(rng, 1)))
+                time.sleep(0.002)
+
+        import threading
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        for gen in range(1, 4):
+            time.sleep(0.05)
+            res = eng.swap_weights({"bias": jnp.float32(float(gen))})
+            assert res["generation"] == gen
+        time.sleep(0.05)
+        stop = True
+        t.join(timeout=5.0)
+        vals = [float(np.asarray(f.result(30))) for f in futs]
+        assert len(vals) == len(futs) and len(futs) > 10
+        snap = eng.stats.snapshot()
+        assert snap["requests"] == len(futs)
+        assert snap["rejected"] == 0 and snap["swaps"] == 3
+    finally:
+        stop = True
+        eng.close()
+
+
+def test_canary_degrade_fires_only_on_non_boot_weights():
+    """The canary_degrade fault point keys off 'serving weights other
+    than the boot weights': silent pre-swap, firing post-swap, standing
+    down after a rollback to the boot tree."""
+    from tpuic.runtime import faults
+
+    faults.reset()
+    faults.arm("canary_degrade", param=0.0)  # 0 s: count-only firing
+    eng = _engine(max_wait_ms=0.0)
+    rng = np.random.default_rng(16)
+    try:
+        eng.warmup()
+        eng.predict(_imgs(rng, 1))
+        assert faults.fired("canary_degrade") == 0
+        eng.swap_weights({"bias": jnp.float32(3.0)})  # the "candidate"
+        eng.predict(_imgs(rng, 1))
+        assert faults.fired("canary_degrade") >= 1
+        n = faults.fired("canary_degrade")
+        eng.swap_weights({"bias": jnp.float32(0.0)})  # rollback to boot
+        assert eng.model_digest == eng._boot_digest
+        eng.predict(_imgs(rng, 1))
+        assert faults.fired("canary_degrade") == n  # stood down
+    finally:
+        eng.close()
+        faults.reset()
+
+
+def test_candidate_outputs_rides_live_executables():
+    """Gate-side candidate evaluation: correct outputs for the
+    candidate tree, zero new compiles, and the serving weights (and
+    what traffic sees) untouched."""
+    from tpuic.analysis.runtime import assert_compiles_flat
+
+    eng = _engine(max_wait_ms=0.0, buckets=(1, 2, 4))
+    rng = np.random.default_rng(17)
+    imgs = _imgs(rng, 7)  # chunks as 4 + 3 -> buckets 4 and 4
+    try:
+        eng.warmup()
+        with assert_compiles_flat(0, what="candidate gate eval"):
+            out = eng.candidate_outputs({"bias": jnp.float32(9.0)}, imgs)
+        want = imgs.astype(np.float64).sum(axis=(1, 2, 3)) + 9.0
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4)
+        # Serving outputs still come from the incumbent tree.
+        got = float(np.asarray(eng.predict(imgs[:1])))
+        assert got == pytest.approx(
+            imgs[:1].astype(np.float64).sum(), rel=1e-5)
+        with pytest.raises(ValueError, match="aval-identical"):
+            eng.candidate_outputs({"bias": jnp.ones((2,), jnp.float32)},
+                                  imgs)
+        with pytest.raises(ValueError, match="unknown serve dtype"):
+            eng.candidate_outputs({"bias": jnp.float32(1.0)}, imgs,
+                                  variant="nope")
+    finally:
+        eng.close()
+
+
+def test_socket_ping_carries_model_identity(tmp_path):
+    """The replica transport's pong (and ready file) carry digest +
+    generation — the router's heterogeneous-fleet signal."""
+    eng, _, ready, stop = _socket_server(tmp_path)
+    try:
+        assert ready["digest"] == eng.model_digest
+        assert ready["generation"] == 0
+        assert ready["dtypes"] == ["fp32"]
+        port = int(ready["port"])
+        lines = _sock_request(port, [{"op": "ping", "id": "p1"}], 1)
+        pong = lines[0]
+        assert pong["op"] == "pong"
+        assert pong["digest"] == eng.model_digest
+        assert pong["generation"] == 0
+        # A swap line on an engine with no swap context: typed error
+        # line, never a crash or a silent drop.
+        lines = _sock_request(
+            port, [{"op": "swap", "id": "s1",
+                    "synthetic_seed": 1}], 1, timeout=30.0)
+        assert "error" in lines[0] and lines[0]["id"] == "s1"
+        assert "swap unsupported" in lines[0]["error"]
+    finally:
+        stop()

@@ -78,3 +78,110 @@ def load_inference_variables(cfg, *, track: str = "best", log=print):
         {"params": state.inference_params,
          "batch_stats": state.batch_stats})
     return model, variables
+
+
+def variables_digest(variables) -> str:
+    """Content digest of an inference variables tree (8 hex chars) —
+    the model-identity tag the serve tier's ready-file/ping protocol
+    and hot-swap ledger carry.  One pinned implementation, shared with
+    the engine (tpuic/serve/engine.py) so a digest computed at load
+    time and one computed by a serving engine always agree."""
+    from tpuic.serve.engine import _tree_digest
+    return _tree_digest(variables)
+
+
+def load_candidate_variables(cfg, *, track: str = "best", log=print):
+    """Gate-grade load of a hot-swap CANDIDATE (docs/serving.md,
+    "Model lifecycle: hot-swap, canary, rollback").
+
+    Stricter than :func:`load_inference_variables` in exactly the ways
+    a weight flip under live traffic demands:
+
+    - **No integrity-ladder fallback.**  ``restore_into`` walks
+      newest → other track → ``.prev`` on corruption — right for a
+      crashed trainer, wrong for a swap: silently flipping the previous
+      rotation into traffic would serve weights the operator never
+      named.  Only the REQUESTED rung is considered.
+    - **The CRC/manifest check is mandatory.**  A candidate without a
+      committed manifest (or failing its per-file CRCs) raises a typed
+      :class:`~tpuic.serve.admission.SwapRejected` with cause
+      ``swap_corrupt`` — the refusal verdict the swap control line
+      returns to the rollout driver, so a bad artifact can never reach
+      traffic.  (Legacy manifest-less checkpoints still *boot* a server
+      via ``load_inference_variables``; they just cannot hot-swap in.)
+    - The incumbent is never touched: everything restores into a fresh
+      state tree, so a failed (or corrupt-rung) load leaves a serving
+      engine's variables bit-identical (tests/test_lifecycle.py).
+
+    Fault point ``swap_corrupt`` (runtime/faults.py): when armed, the
+    candidate's largest payload file is corrupted *after* it is located
+    but *before* verification — the bit-rot-between-producer-and-gate
+    shape the CRC gate exists to catch.
+
+    Returns ``(model, variables, digest)`` with ``variables`` on
+    device and ``digest`` the :func:`variables_digest` identity tag.
+    """
+    import jax
+
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.models import create_model_from_config
+    from tpuic.runtime import faults as _faults
+    from tpuic.serve.admission import SwapRejected
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+
+    mcfg = cfg.model
+    mgr = CheckpointManager(cfg.run.ckpt_dir, mcfg.name)
+    path = os.path.join(mgr.root, track)
+    if not os.path.isdir(path):
+        raise SwapRejected(
+            f"swap candidate missing: no '{track}' checkpoint under "
+            f"{mgr.root}", cause="swap_corrupt")
+    if _faults.fire("swap_corrupt"):
+        victim, size = None, -1
+        for dirpath, _, filenames in os.walk(path):
+            for fn in filenames:
+                fp = os.path.join(dirpath, fn)
+                if os.path.getsize(fp) > size:
+                    victim, size = fp, os.path.getsize(fp)
+        if victim is not None:
+            _faults.corrupt_file(victim)
+            log(f"[swap] fault 'swap_corrupt': corrupted "
+                f"{os.path.relpath(victim, path)} pre-verification")
+    if not os.path.exists(path + ".manifest.json"):
+        raise SwapRejected(
+            f"swap candidate {mgr.root}/{track} has no commit manifest "
+            "— the swap gate requires CRC-verifiable bytes (recommit "
+            "with a current CheckpointManager)", cause="swap_corrupt")
+    ok, detail = mgr.verify_track(track)
+    if not ok:
+        raise SwapRejected(
+            f"swap candidate {mgr.root}/{track} failed the integrity "
+            f"gate: {detail}", cause="swap_corrupt")
+
+    model = create_model_from_config(mcfg)
+    state = create_train_state(
+        model, make_optimizer(cfg.optim), jax.random.key(0),
+        (1, cfg.data.resize_size, cfg.data.resize_size, 3),
+        ema=cfg.optim.ema_decay > 0)
+    try:
+        state, _, best = mgr.restore_exact(state, track)
+    except Exception as e:
+        # Verified bytes that still fail to restore (structure drift,
+        # torn orbax metadata the CRC can't see): same typed refusal —
+        # the candidate cannot reach traffic either way.
+        raise SwapRejected(
+            f"swap candidate {mgr.root}/{track} failed to restore: "
+            f"{type(e).__name__}: {e}", cause="swap_corrupt") from e
+    loaded = mgr.last_restore_loaded
+    if loaded is not None and loaded[0] < loaded[1]:
+        raise ValueError(
+            f"swap candidate {mgr.root}/{track} restored only "
+            f"{loaded[0]}/{loaded[1]} leaves into model '{mcfg.name}' — "
+            "wrong model/num_classes for this checkpoint")
+    variables = {"params": state.inference_params,
+                 "batch_stats": state.batch_stats}
+    digest = variables_digest(variables)
+    log(f"[swap] candidate {mcfg.name}/{track} verified "
+        f"({detail}; best {best:.2f}, digest {digest})")
+    return model, jax.device_put(variables), digest

@@ -588,6 +588,27 @@ class CheckpointManager:
             "no restorable checkpoint: every integrity-ladder rung failed "
             "(" + "; ".join(failures) + ")")
 
+    def restore_exact(self, state, track: str):
+        """Single-rung restore with NO ladder fallback — the hot-swap
+        gate's read path (docs/serving.md, "Model lifecycle").
+
+        ``restore_into`` ladders newest → other track → ``.prev`` on
+        corruption, which is the right call for a crashed trainer but
+        exactly wrong for a swap CANDIDATE: silently restoring the
+        previous rotation would flip different weights into traffic
+        than the operator named.  The caller verifies THIS rung
+        (``verify_track``) first; any read failure here raises rather
+        than falling back.  Returns (state, start_epoch, best_score)
+        and sets the same ``last_restore_*`` attributes as
+        ``restore_into``."""
+        self.wait()
+        self.last_restore_loaded = None
+        self.last_restore_step_in_epoch = None
+        self.last_restore_geometry = None
+        self.last_restore_meta = None
+        self.last_restore_rung = track
+        return self._restore_track(state, track)
+
     def _restore_track(self, state, track: str):
         """Restore one (existing, verified-or-unverifiable) track.
 

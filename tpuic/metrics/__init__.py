@@ -1,4 +1,40 @@
-from tpuic.metrics.meters import (AverageMeter, LatencyMeter,  # noqa: F401
-                                  accuracy, quantile, quantile_label,
-                                  quantiles, topk_accuracy)
-from tpuic.metrics.logging import host0_print, MetricLogger  # noqa: F401
+"""tpuic.metrics — meters, quantiles, host-0 logging.
+
+Re-exports resolve lazily (PEP 562, the tpuic/__init__.py idiom):
+``tpuic.metrics.meters`` is stdlib-importable (its jax-consuming
+helpers import jax inside the function), and the stdlib-only serve
+tiers — the replica router and the canary rollout driver
+(tpuic/serve/rollout.py), which reuses telemetry/slo.py and therefore
+the pinned ``meters.quantile`` — must be able to import it without
+pulling the jax stack into a parent process that has to outlive a
+backend wedge.  ``logging`` (host0_print / MetricLogger) stays
+jax-backed and loads only when asked for.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "AverageMeter": ("tpuic.metrics.meters", "AverageMeter"),
+    "LatencyMeter": ("tpuic.metrics.meters", "LatencyMeter"),
+    "accuracy": ("tpuic.metrics.meters", "accuracy"),
+    "quantile": ("tpuic.metrics.meters", "quantile"),
+    "quantile_label": ("tpuic.metrics.meters", "quantile_label"),
+    "quantiles": ("tpuic.metrics.meters", "quantiles"),
+    "topk_accuracy": ("tpuic.metrics.meters", "topk_accuracy"),
+    "host0_print": ("tpuic.metrics.logging", "host0_print"),
+    "MetricLogger": ("tpuic.metrics.logging", "MetricLogger"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: next access skips the import
+        return value
+    raise AttributeError(f"module 'tpuic.metrics' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

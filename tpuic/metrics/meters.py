@@ -15,10 +15,17 @@ TPU-native re-design of the reference's ``utils.py``:
 from __future__ import annotations
 
 import math
-from typing import Dict, Iterable, Sequence
+from typing import TYPE_CHECKING, Dict, Iterable, Sequence
 
-import jax
-import jax.numpy as jnp
+if TYPE_CHECKING:  # pragma: no cover — annotations only
+    import jax.numpy as jnp
+
+# NOTE: no module-level jax import.  The stdlib-only serve tiers (the
+# replica router and the canary rollout driver) import this module for
+# the pinned quantile helpers — via tpuic/telemetry/slo.py — and must
+# never pull the jax stack into a parent process that has to outlive a
+# backend wedge.  accuracy()/topk_accuracy() import jax inside the
+# function, where only jax-running callers (the train step) ever are.
 
 
 # -- the one quantile implementation -----------------------------------------
@@ -180,6 +187,7 @@ def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
     logits: [B, C] float; labels: [B] int. Returns [B] float32 of 0.0/1.0.
     """
+    import jax.numpy as jnp
     return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
 
 
@@ -190,6 +198,8 @@ def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
 
     logits: [B, C] float; labels: [B] int. Returns [B] float32 of 0.0/1.0.
     """
+    import jax
+    import jax.numpy as jnp
     k = min(k, logits.shape[-1])
     _, idx = jax.lax.top_k(logits, k)  # [B, k]
     return jnp.any(idx == labels[:, None], axis=-1).astype(jnp.float32)

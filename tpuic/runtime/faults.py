@@ -69,6 +69,25 @@ Registered points (site → meaning of ``step``):
                       router's breaker + in-flight failover path
                       (tpuic/serve/router.py, docs/serving.md "Replica
                       routing and failover").
+- ``swap_corrupt``  — hot-swap admission gate (checkpoint/loading.py
+                      ``load_candidate_variables``): corrupt the swap
+                      CANDIDATE's staged bytes (one payload file,
+                      :func:`corrupt_file`) after it is located but
+                      BEFORE the CRC/manifest verification — bit-rot
+                      between producer and gate.  The gate must then
+                      refuse the candidate with a typed
+                      ``swap_corrupt`` verdict and the incumbent keeps
+                      serving (docs/serving.md, "Model lifecycle").
+- ``canary_degrade``— serve engine batcher (serve/engine.py
+                      ``_dispatch``): sleep ``param`` seconds (default
+                      0.05) per device batch, but ONLY while the engine
+                      serves weights other than the ones it booted with
+                      — i.e. the candidate a hot-swap installed.  A
+                      fleet armed with ``canary_degrade#0.2`` degrades
+                      exactly the canary replicas mid-rollout (the
+                      SLO-burn auto-rollback trigger); rolling back to
+                      the boot weights stands the fault down, so the
+                      post-rollback fleet is provably healthy again.
 - ``replica_wedge`` — serve socket transport: stop servicing the socket
                       at the Nth accepted request (sleep ``param``
                       seconds; effectively forever without a payload) —
@@ -116,7 +135,8 @@ __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
 REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
     "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
-    "rank_hang", "replica_crash", "replica_wedge",
+    "rank_hang", "replica_crash", "replica_wedge", "swap_corrupt",
+    "canary_degrade",
 })
 
 

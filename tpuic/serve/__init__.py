@@ -50,6 +50,10 @@ _LAZY = {
     "RouterStats": ("tpuic.serve.router", "RouterStats"),
     "CircuitBreaker": ("tpuic.serve.router", "CircuitBreaker"),
     "RetryBudget": ("tpuic.serve.router", "RetryBudget"),
+    # model lifecycle (stdlib-only modules)
+    "CanaryRollout": ("tpuic.serve.rollout", "CanaryRollout"),
+    "RouterHTTPServer": ("tpuic.serve.http", "RouterHTTPServer"),
+    "SwapRejected": ("tpuic.serve.admission", "SwapRejected"),
 }
 
 

@@ -43,8 +43,10 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 from collections import deque
+from concurrent.futures import Future as _FutFuture
 from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as np
@@ -140,8 +142,16 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
     srv.setblocking(False)
     bound = srv.getsockname()[1]
     if ready_file:
+        # Model identity rides the handoff (docs/serving.md, "Model
+        # lifecycle"): digest + dtype-ladder tags let the router refuse
+        # a silently-heterogeneous fleet before routing one request.
+        # The ready file records the BOOT identity; the live identity
+        # (post-swap) is whatever the pong says.
         wire.write_ready_file(ready_file, port=int(bound), pid=os.getpid(),
-                              prom_port=prom_port)
+                              prom_port=prom_port,
+                              digest=engine.model_digest,
+                              dtypes=list(engine.variant_tags()),
+                              generation=engine.generation)
     log(f"[serve] socket-JSONL transport on {host}:{bound}"
         + (f" (ready file {ready_file})" if ready_file else ""))
 
@@ -226,7 +236,22 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
                         "queue_depth": engine.queue_depth(),
                         "inflight": sum(len(s["pending"])
                                         for s in conns.values()),
+                        # Model identity (docs/serving.md, "Model
+                        # lifecycle"): the router's heterogeneous-fleet
+                        # gate and the rollout driver's promotion check
+                        # both read the LIVE digest from pongs — a
+                        # hot-swap shows up within one ping interval.
+                        "digest": engine.model_digest,
+                        "generation": engine.generation,
                         "pid": os.getpid()})
+            return
+        if req.get("op") == "swap":
+            # Control line, not traffic: gates + flips on a worker
+            # thread (submit_swap) so pings keep flowing; the result
+            # record (or typed swap_corrupt/swap_accuracy verdict)
+            # rides the normal pending/flush machinery, keyed by id.
+            rid = str(req.get("id", "swap"))
+            st["pending"].append((rid, submit_swap(engine, req, log)))
             return
         accepted += 1
         if _faults.fire("replica_crash", accepted):
@@ -278,10 +303,16 @@ def serve_socket(engine, *, listen: str, names, top_k: int, size: int,
             elif fut.exception() is not None:
                 send(sock, wire.error_record(rid, fut.exception()))
             else:
-                probs, order = fut.result()
-                send(sock, _result_record(rid, probs, order, names,
-                                          top_k))
-                served += 1
+                res = fut.result()
+                if isinstance(res, dict):
+                    # Control-line outcome (a swap_result): already a
+                    # wire record — not counted as served traffic.
+                    send(sock, {**res, "id": rid})
+                else:
+                    probs, order = res
+                    send(sock, _result_record(rid, probs, order, names,
+                                              top_k))
+                    served += 1
             if sock not in conns:
                 # send() failed and close_conn ran: it swallowed what
                 # was left on the ORPHANED state dict, but the entries
@@ -448,6 +479,193 @@ def _ladder_variants(model, variables, tags, size, *, mean, std, log):
     return variants
 
 
+# One swap at a time per process: the gate + stage + flip sequence is
+# itself atomic from the operator's view, and a second candidate racing
+# the first would gate against a moving incumbent.  Created at import
+# (lazy creation would itself race two first swaps into separate locks).
+_SWAP_LOCK = threading.Lock()
+
+
+def _swap_context(engine, *, model, model_name: str, num_classes: int,
+                  resize: int, tags, mean, std, ckpt_dir: str,
+                  track: str) -> None:
+    """Attach everything a later ``{"op": "swap"}`` control line needs
+    to rebuild and gate a candidate ladder for THIS engine (model
+    architecture, ladder tags, normalize stats, default checkpoint
+    location).  Engines built outside this CLI (tests, embedders)
+    simply have no context and refuse swap lines with a typed error."""
+    engine.tpuic_swap_ctx = {
+        "model": model, "model_name": model_name,
+        "num_classes": int(num_classes), "resize": int(resize),
+        "tags": tuple(tags), "mean": mean, "std": std,
+        "ckpt_dir": ckpt_dir, "track": track,
+    }
+
+
+def _gate_outputs(engine, tree, imgs, tag: str):
+    """Candidate outputs for one rung: through the engine's live AOT
+    executables when the candidate is aval-identical (zero compiles —
+    the hot-swap case the soak pins), else a one-off jit of the rung's
+    forward (the aval-mismatch case prewarms executables in
+    swap_weights anyway, so the gate compile is not the anomaly)."""
+    try:
+        return engine.candidate_outputs(tree, imgs, variant=tag)
+    except ValueError:
+        import jax
+        fwd = engine._variants[tag][0]
+        arr = np.asarray(imgs, engine.input_dtype)
+        return jax.jit(fwd)(jax.device_put(tree), arr)
+
+
+def run_swap(engine, req: dict, log) -> dict:
+    """Gate + stage + flip for one ``{"op": "swap", ...}`` control line
+    (docs/serving.md, "Model lifecycle: hot-swap, canary, rollback").
+
+    Candidate source: ``{"ckpt_dir", "track"}`` (defaults: the serving
+    checkpoint location) loads through the STRICT verified path
+    (checkpoint/loading.py ``load_candidate_variables`` — CRC/manifest
+    mandatory, no ladder fallback, typed ``swap_corrupt`` refusal), or
+    ``{"synthetic_seed": N}`` re-inits the architecture from a seed
+    (the load-test / soak candidate, no artifact to verify).
+
+    Pre-flip admission gates, in order:
+
+    1. **Integrity** — the candidate's bytes match its commit manifest
+       (``swap_corrupt`` refusal; checkpoint candidates only).
+    2. **Pinned-eval accuracy** — the candidate's fp32 outputs are
+       finite on the pinned synthetic eval set (tpuic/quant
+       ``eval_images``), and every configured dtype-ladder rung built
+       from the candidate agrees with the candidate's own fp32 top-1
+       within the committed epsilon — the PR-13 startup gate re-run
+       per swap (``swap_accuracy`` refusal).  Gate evaluation rides the
+       live generation's executables (``engine.candidate_outputs``):
+       zero new compiles for aval-identical candidates.
+    3. The flip itself is ``engine.swap_weights`` — the whole ladder as
+       one unit, zero-drain by construction.
+
+    A refused candidate never touches traffic: the incumbent keeps
+    serving, untouched, and the caller gets the typed verdict.
+    Raises ``SwapRejected`` / ``ValueError``; returns the
+    ``swap_result`` record on success."""
+    from tpuic.serve.admission import SwapRejected
+    ctx = getattr(engine, "tpuic_swap_ctx", None)
+    if ctx is None:
+        raise ValueError("swap unsupported: this engine was built "
+                         "without a swap context")
+    if not _SWAP_LOCK.acquire(blocking=False):
+        raise RuntimeError("swap already in progress — one candidate "
+                           "at a time")
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tpuic import quant
+        from tpuic.checkpoint.loading import load_candidate_variables
+        from tpuic.config import (Config, DataConfig, ModelConfig,
+                                  OptimConfig, RunConfig)
+        resize, tags = ctx["resize"], ctx["tags"]
+        default = tags[0]
+        if req.get("synthetic_seed") is not None:
+            seed = int(req["synthetic_seed"])
+            variables = ctx["model"].init(
+                jax.random.key(seed),
+                jnp.zeros((1, resize, resize, 3), jnp.float32),
+                train=False)
+            source = f"synthetic:{seed}"
+        else:
+            ckpt_dir = str(req.get("ckpt_dir") or ctx["ckpt_dir"] or "")
+            if not ckpt_dir:
+                raise ValueError(
+                    "swap line needs 'ckpt_dir' (or 'synthetic_seed')")
+            track = str(req.get("track") or ctx["track"] or "best")
+            cfg = Config(
+                data=DataConfig(data_dir=".", resize_size=resize),
+                model=ModelConfig(name=ctx["model_name"],
+                                  num_classes=ctx["num_classes"]),
+                optim=OptimConfig(
+                    ema_decay=_sidecar_ema(ckpt_dir, ctx["model_name"])),
+                run=RunConfig(ckpt_dir=ckpt_dir))
+            _, variables, _ = load_candidate_variables(
+                cfg, track=track, log=log)
+            source = os.path.join(ckpt_dir, ctx["model_name"], track)
+        # Rebuild the dtype ladder FROM the candidate (the ladder swaps
+        # as one unit — engine.swap_weights enforces the tag set).
+        trees = {default: variables}
+        for tag in tags[1:]:
+            if tag == "bf16":
+                trees[tag] = quant.bf16_variables(variables)
+            elif tag == "int8":
+                trees[tag] = quant.quantize_variables(variables)
+            else:
+                raise ValueError(f"unknown ladder rung {tag!r}")
+        # Pinned-eval accuracy gate (pre-flip, off the request path).
+        imgs = quant.eval_images(128, resize)
+        ref = _gate_outputs(engine, trees[default], imgs, default)
+        ref_probs, ref_order = (np.asarray(ref[0]), np.asarray(ref[1]))
+        if not np.isfinite(ref_probs).all():
+            raise SwapRejected(
+                f"swap candidate {source} produced non-finite outputs "
+                "on the pinned eval set — refusing to flip garbage "
+                "into traffic", cause="swap_accuracy")
+        floor = 1.0 - quant.DEFAULT_EPSILON
+        for tag in tags[1:]:
+            out_t = _gate_outputs(engine, trees[tag], imgs, tag)
+            t_probs, t_order = (np.asarray(out_t[0]), np.asarray(out_t[1]))
+            agree = float(np.mean(ref_order[:, 0] == t_order[:, 0]))
+            if not np.isfinite(t_probs).all() or agree < floor:
+                raise SwapRejected(
+                    f"swap candidate {source} rung {tag!r} FAILED the "
+                    f"accuracy gate: top-1 agreement with the "
+                    f"candidate's fp32 is {agree:.4f} < {floor:.4f} on "
+                    f"the pinned eval set (epsilon "
+                    f"{quant.DEFAULT_EPSILON})", cause="swap_accuracy")
+        res = engine.swap_weights(
+            trees[default],
+            variants={t: trees[t] for t in tags[1:]})
+        how = ("executables reused" if res["reused_executables"]
+               else f"{res['prewarmed']} executables prewarmed")
+        log(f"[serve] hot-swap OK: {source} -> generation "
+            f"{res['generation']} digest {res['digest']} ({how}, "
+            f"{res['duration_s'] * 1000:.0f} ms)")
+        return {"op": "swap_result", "ok": True, "source": source, **res}
+    finally:
+        _SWAP_LOCK.release()
+
+
+def submit_swap(engine, req: dict, log):
+    """Run the swap gate + flip on a worker thread, returning a Future
+    that resolves to the ``swap_result`` record (or the typed verdict).
+
+    Both transports ride their existing completion machinery: the
+    future joins the pending deque like any request, so the accept /
+    select loop keeps serving traffic and answering pings while the
+    candidate loads and gates — the whole point of a ZERO-downtime
+    lifecycle.  (A checkpoint load inside the select loop would stall
+    pings past the router's window and read as a wedge.)"""
+    fut = _FutFuture()
+
+    def _worker() -> None:
+        try:
+            fut.set_result(run_swap(engine, req, log))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=_worker, daemon=True,
+                     name="tpuic-swap").start()
+    return fut
+
+
+def _sidecar_ema(ckpt_dir: str, model_name: str) -> float:
+    """ema_decay from a checkpoint dir's config.json sidecar (0.0 when
+    absent/corrupt — the same lenient rule build_engine applies)."""
+    try:
+        with open(os.path.join(ckpt_dir, model_name, "config.json")) as f:
+            return float(
+                json.load(f).get("optim", {}).get("ema_decay", 0.0))
+    except (OSError, ValueError, TypeError):
+        return 0.0
+
+
 def build_engine(args):
     """Checkpoint -> warmed InferenceEngine (shared predict loading rules)."""
     if args.compile_cache_dir:
@@ -502,6 +720,10 @@ def build_engine(args):
                     for v in t.values())
         print(f"[serve] synthetic init ({args.model}); warmup compiled "
               f"{n_exe} bucket executables: {t}", file=sys.stderr)
+        _swap_context(engine, model=model, model_name=args.model,
+                      num_classes=args.num_classes, resize=resize,
+                      tags=tags, mean=dc.mean, std=dc.std,
+                      ckpt_dir=args.ckpt_dir, track=args.track)
         return engine, resize, args.num_classes, args.model
 
     model_name, num_classes, resize = args.model, args.num_classes, args.resize
@@ -564,6 +786,10 @@ def build_engine(args):
     n_exe = sum(len(v) if isinstance(v, dict) else 1 for v in t.values())
     print(f"[serve] warmup compiled {n_exe} bucket executables: {t}",
           file=sys.stderr)
+    _swap_context(engine, model=model, model_name=model_name,
+                  num_classes=num_classes, resize=resize, tags=tags,
+                  mean=cfg.data.mean, std=cfg.data.std,
+                  ckpt_dir=args.ckpt_dir, track=args.track)
     return engine, resize, num_classes, model_name
 
 
@@ -850,6 +1076,12 @@ def main(argv=None) -> int:
     k = max(1, min(args.top_k, num_classes))
     out = open(args.out, "w") if args.out else sys.stdout
     pending = deque()  # (id, Future) in submission order
+    # Control futures (swap lines) drain OUT of order, in their own
+    # lane: a checkpoint load + gate takes seconds, and the in-order
+    # traffic drain must not head-of-line block every predict answered
+    # behind it (responses are keyed by id — order is not part of the
+    # control contract).
+    control_pending = deque()
     served = 0
 
     def emit(rid, probs, order) -> None:
@@ -858,6 +1090,72 @@ def main(argv=None) -> int:
                                             names, k)) + "\n")
         out.flush()
         served += 1
+
+    def emit_outcome(rid, res) -> None:
+        """One resolved future: a (probs, order) result emits the usual
+        record; a dict is a control-line outcome (swap_result) and is
+        written as-is — not counted as served traffic."""
+        if isinstance(res, dict):
+            out.write(json.dumps({**res, "id": rid}) + "\n")
+            out.flush()
+        else:
+            emit(rid, res[0], res[1])
+
+    def drain_control(block: bool = False, deadline: float = None
+                      ) -> None:
+        """Emit completed control-line outcomes, any order (responses
+        are keyed by id — control order is not part of the contract,
+        and a seconds-long swap must never head-of-line block traffic
+        results).  ``block`` waits each out, bounded by ``deadline``;
+        past it the straggler gets an explicit error line — the same
+        never-a-silent-drop rule as drain()."""
+        still = deque()
+        while control_pending:
+            rid, fut = control_pending.popleft()
+            if not fut.done():
+                if not block:
+                    still.append((rid, fut))
+                    continue
+                # Same escalation discipline as drain(): the
+                # no-deadline wait polls in short slices re-checking
+                # the SIGTERM latch (PEP 475 would resume a bare
+                # result() right through the signal — a wedged swap
+                # worker would make the server unkillable), and the
+                # latch converts the wait into a --drain-timeout
+                # deadline.
+                if deadline is None:
+                    while not fut.done() and not guard.triggered:
+                        try:
+                            fut.result(timeout=0.5)
+                        except (TimeoutError, _FutTimeout):
+                            pass
+                        except Exception:  # noqa: BLE001
+                            break  # done with an exception: read below
+                    if not fut.done() and guard.triggered:
+                        deadline = (time.monotonic()
+                                    + max(0.0, args.drain_timeout))
+                try:
+                    if deadline is not None and not fut.done():
+                        fut.result(timeout=max(
+                            0.0, deadline - time.monotonic()))
+                except (TimeoutError, _FutTimeout):
+                    fut.cancel()
+                    out.write(wire.error_line(
+                        rid, "drain timeout: swap unresolved at "
+                        "shutdown"))
+                    out.flush()
+                    continue
+                except Exception:  # noqa: BLE001 — read below
+                    pass
+            if fut.cancelled():
+                out.write(wire.error_line(rid, "cancelled"))
+                out.flush()
+            elif fut.exception() is not None:
+                out.write(wire.error_line(rid, fut.exception()))
+                out.flush()
+            else:
+                emit_outcome(rid, fut.result())
+        control_pending.extend(still)
 
     def drain(block: bool, deadline: float = None) -> None:
         """Emit completed responses; ``block`` waits for stragglers, up to
@@ -871,6 +1169,7 @@ def main(argv=None) -> int:
         signals (PEP 475), so a SIGTERM arriving while draining a wedged
         request at EOF would otherwise never be observed — the latch
         escalates the wait to a ``--drain-timeout`` deadline instead."""
+        drain_control()  # opportunistic; the blocking pass runs last
         while pending and (block or pending[0][1].done()):
             rid, fut = pending.popleft()
             try:
@@ -886,9 +1185,9 @@ def main(argv=None) -> int:
                         deadline = (time.monotonic()
                                     + max(0.0, args.drain_timeout))
                 if deadline is None:
-                    probs, order = fut.result()
+                    res = fut.result()
                 else:
-                    probs, order = fut.result(
+                    res = fut.result(
                         timeout=max(0.0, deadline - time.monotonic()))
             except (TimeoutError, _FutTimeout):
                 pending.appendleft((rid, fut))
@@ -897,17 +1196,18 @@ def main(argv=None) -> int:
                 for srid, sfut in expired:
                     if sfut.done() and not sfut.cancelled():
                         try:
-                            p, o = sfut.result()
+                            sres = sfut.result()
                         except Exception as e:  # noqa: BLE001
                             out.write(wire.error_line(srid, e))
                         else:
-                            emit(srid, p, o)
+                            emit_outcome(srid, sres)
                         continue
                     sfut.cancel()  # not-yet-dispatched may still cancel
                     out.write(wire.error_line(
                         srid, "drain timeout: engine shutting down "
                         "before this request finished"))
                 out.flush()
+                drain_control(block=True, deadline=deadline)
                 return
             except Exception as e:  # noqa: BLE001 — per-request error line
                 # wire.error_line types the verdict (a pop-time
@@ -923,7 +1223,11 @@ def main(argv=None) -> int:
                 # drain still owns it (never a silent drop).
                 pending.appendleft((rid, fut))
                 raise
-            emit(rid, probs, order)
+            emit_outcome(rid, res)
+        if block:
+            # Traffic drained in order; control outcomes last, bounded
+            # by the same deadline.
+            drain_control(block=True, deadline=deadline)
 
     def submit(rid: str, path: str, **sla) -> bool:
         """Decode + enqueue; False = decode failed (error line emitted).
@@ -1013,6 +1317,21 @@ def main(argv=None) -> int:
                     return
                 try:
                     req = json.loads(line)
+                    if not isinstance(req, dict):
+                        raise TypeError("not an object")
+                    if req.get("op") == "swap":
+                        # Control line (docs/serving.md, "Model
+                        # lifecycle"): gate + flip off-thread; the
+                        # swap_result (or typed verdict) drains on the
+                        # CONTROL lane, out of order — a seconds-long
+                        # checkpoint load must not head-of-line block
+                        # the in-order traffic drain behind it.
+                        control_pending.append(
+                            (str(req.get("id", "swap")),
+                             submit_swap(engine, req,
+                                         lambda m: print(
+                                             m, file=sys.stderr))))
+                        return
                     path = req["path"]
                 except (ValueError, KeyError, TypeError):
                     out.write(wire.error_line(
@@ -1112,10 +1431,18 @@ def main(argv=None) -> int:
             # Attribution companion to the [slo] line: the rejected_by
             # split says whether budget burn came from sheds (deadline /
             # brownout causes) or from slow service (no sheds, blown
-            # attainment).
+            # attainment).  The FULL typed vocabulary is folded in —
+            # zero-filled causes included — so a soak ledger attributes
+            # every cause (replica_lost, the swap verdicts) from this
+            # one line without grepping raw JSONL for causes that
+            # happened not to fire.
+            from tpuic.serve.admission import CAUSES
             snap = engine.stats.snapshot()
+            rej = {c: snap["rejected_by"].get(c, {}) for c in CAUSES}
+            rej.update({c: by for c, by in snap["rejected_by"].items()
+                        if c not in rej})  # never drop an unknown cause
             print(f"[admission] state={json.dumps(admission_ctl.state())} "
-                  f"rejected_by={json.dumps(snap['rejected_by'])}",
+                  f"rejected_by={json.dumps(rej)}",
                   file=sys.stderr)
         print(f"[serve] served {served} requests; stats: "
               f"{json.dumps(engine.stats.snapshot())}", file=sys.stderr)

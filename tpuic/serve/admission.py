@@ -63,8 +63,14 @@ DEFAULT_PRIORITY = "normal"
 # ``replica_lost`` is the routing tier's verdict (tpuic/serve/router.py):
 # the replica serving a request died and the request could not be safely
 # replayed (non-idempotent, retries exhausted, or the retry budget dry).
+# ``swap_corrupt``/``swap_accuracy`` are the model-lifecycle tier's
+# verdicts (docs/serving.md, "Model lifecycle"): a hot-swap CANDIDATE
+# refused at the pre-flip gate — failed the checkpoint CRC/manifest
+# integrity ladder, or failed the pinned-eval accuracy gate — so a bad
+# artifact never reaches traffic.  They label the refused swap request,
+# never serving traffic.
 CAUSES: Tuple[str, ...] = ("queue_full", "deadline", "quota", "brownout",
-                           "replica_lost")
+                           "replica_lost", "swap_corrupt", "swap_accuracy")
 
 # The --quota spec key for the shared free pool.
 FREE_POOL = "*"
@@ -122,6 +128,27 @@ class ReplicaLost(AdmissionError):
     def __init__(self, message: str, *, priority: str = DEFAULT_PRIORITY,
                  tenant: Optional[str] = None) -> None:
         super().__init__(message, cause="replica_lost", priority=priority,
+                         tenant=tenant)
+
+
+class SwapRejected(AdmissionError):
+    """Swap-time gate verdict (docs/serving.md, "Model lifecycle"):
+    a hot-swap candidate was refused BEFORE the weight flip — it never
+    served a request.  ``cause`` is ``swap_corrupt`` (the candidate
+    failed the checkpoint CRC/manifest integrity check: missing,
+    manifest-less, or bytes that don't match their recorded checksums)
+    or ``swap_accuracy`` (the candidate failed the pinned-eval gate:
+    non-finite outputs, or a dtype-ladder rung disagreeing with the
+    candidate's own fp32 past the committed epsilon).  The incumbent
+    keeps serving untouched — refusal is always zero-downtime."""
+
+    def __init__(self, message: str, *, cause: str = "swap_corrupt",
+                 priority: str = DEFAULT_PRIORITY,
+                 tenant: Optional[str] = None) -> None:
+        if cause not in ("swap_corrupt", "swap_accuracy"):
+            raise ValueError(f"SwapRejected cause must be swap_corrupt or "
+                             f"swap_accuracy, got {cause!r}")
+        super().__init__(message, cause=cause, priority=priority,
                          tenant=tenant)
 
 

@@ -129,6 +129,64 @@ class _Request:
                          else self.t_enqueue + float(deadline_ms) / 1000.0)
 
 
+def _tree_digest(variables) -> str:
+    """Content digest of a variables tree: CRC32 folded over every
+    leaf's path, shape, dtype, and bytes — 8 hex chars.  This is the
+    model-identity tag the ready-file/ping protocol carries
+    (docs/serving.md, "Model lifecycle"): two replicas with the same
+    digest serve bitwise-identical weights, so the router can refuse a
+    silently-heterogeneous fleet.  One host pass over the tree —
+    swap/startup-time only, never the request path."""
+    import zlib
+
+    import jax
+    crc = 0
+    leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)  # tpuic-ok: TPU101 one-time identity hash at swap/startup, not a hot path
+        head = f"{jax.tree_util.keystr(path)}|{arr.shape}|{arr.dtype}|"
+        crc = zlib.crc32(head.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def _tree_avals(variables):
+    """Hashable (path, shape, dtype) signature of a tree — the
+    executable-compatibility key: two trees with equal signatures can
+    run through the same AOT executables (variables are *arguments* of
+    the compiled forward, not baked into it)."""
+    import jax
+    return tuple(
+        (jax.tree_util.keystr(path), tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0])
+
+
+class _Generation:
+    """One immutable serving generation (docs/serving.md, "Model
+    lifecycle: hot-swap, canary, rollback"): the variant map
+    ``{tag: (forward, device-resident variables)}`` plus the AOT
+    executables those variants run through.  The engine holds exactly
+    one live reference (``engine._gen``); ``swap_weights`` builds the
+    next generation completely off-path — staged on device, executables
+    reused or prewarmed — and then flips that single reference, so a
+    device batch (which reads the reference once, at dispatch) is
+    all-old or all-new, never mixed, and nothing ever drains.
+
+    ``executables`` may be SHARED with the previous generation when the
+    new trees are aval-identical (the executables take variables as
+    call arguments — same shapes/dtypes means zero recompiles)."""
+
+    __slots__ = ("variants", "executables", "generation", "digest")
+
+    def __init__(self, variants: dict, executables: dict,
+                 generation: int, digest: str) -> None:
+        self.variants = variants
+        self.executables = executables
+        self.generation = generation
+        self.digest = digest
+
+
 class _PriorityQueue:
     """Bounded multi-class FIFO (docs/serving.md, "Admission control and
     overload"): one lane per priority class, ``get`` pops the highest
@@ -273,17 +331,28 @@ class InferenceEngine:
         # (variant, bucket) into the one AOT cache, so the zero
         # steady-state-compile contract holds per rung.
         self.default_variant = str(default_variant)
-        self._variants = {self.default_variant: (self._forward,
-                                                 self._variables)}
+        gen_variants = {self.default_variant: (self._forward,
+                                               self._variables)}
         for tag, (fwd, vs) in (variants or {}).items():
             tag = str(tag)
             if tag == self.default_variant:
                 continue  # the constructor pair IS the default rung
-            self._variants[tag] = (fwd, jax.device_put(vs))
-        self._executables = {}
+            gen_variants[tag] = (fwd, jax.device_put(vs))
+        # The live generation (docs/serving.md, "Model lifecycle"): ONE
+        # reference the batcher reads once per dispatch; swap_weights
+        # flips it between batches — atomic hot-swap, nothing drains.
+        self._gen = _Generation(gen_variants, {}, 0,
+                                _tree_digest(variables))
+        # The boot digest: the canary_degrade fault point keys off
+        # "serving weights other than the ones this process booted
+        # with" (runtime/faults.py) — rollback restores the boot digest
+        # and stands the fault down.
+        self._boot_digest = self._gen.digest
         self._compile_lock = threading.Lock()
+        self._swap_lock = threading.Lock()
         self._jax = jax
         self.stats = stats if stats is not None else ServeStats()
+        self.stats.note_identity(self._gen.digest)
         # Request-scoped tracing: every submit gets the next trace id
         # (itertools.count is safe under the GIL for concurrent callers).
         self._traces = itertools.count(1)
@@ -299,6 +368,34 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         if autostart:
             self.start()
+
+    # -- generation views ----------------------------------------------
+    @property
+    def _variants(self) -> dict:
+        """The LIVE generation's variant map (one reference read)."""
+        return self._gen.variants
+
+    @property
+    def _executables(self) -> dict:
+        """The live generation's AOT executable cache."""
+        return self._gen.executables
+
+    @property
+    def generation(self) -> int:
+        """Weight generation counter: 0 at boot, +1 per hot-swap."""
+        return self._gen.generation
+
+    @property
+    def model_digest(self) -> str:
+        """Content digest of the live default-rung weights — the
+        identity tag the ready-file/ping protocol carries."""
+        return self._gen.digest
+
+    def variant_tags(self) -> tuple:
+        """Configured dtype-ladder tags, default rung first."""
+        tags = list(self._gen.variants)
+        tags.remove(self.default_variant)
+        return (self.default_variant, *tags)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "InferenceEngine":
@@ -363,28 +460,29 @@ class InferenceEngine:
         (model, variant, bucket) the HLO also lands in the persistent
         XLA compilation cache when one is configured, so the *next*
         process warms up from disk."""
+        gen = self._gen
         per_variant = {}
-        for tag in self._variants:
+        for tag in gen.variants:
             timings = {}
             for b in self.buckets:
                 t0 = time.perf_counter()
-                self._compile(tag, b)
+                self._compile(gen, tag, b)
                 timings[b] = round(time.perf_counter() - t0, 3)
             per_variant[tag] = timings
         if len(per_variant) == 1:
             return per_variant[self.default_variant]
         return per_variant
 
-    def _compile(self, variant: str, bucket: int):
-        # Serialized: warmup() (caller thread) and the batcher's lazy
-        # fallback may race on the same bucket; without the lock both
-        # would compile it and the compiles-flat contract would report
-        # phantom recompiles.
+    def _compile(self, gen: _Generation, variant: str, bucket: int):
+        # Serialized: warmup() (caller thread), the batcher's lazy
+        # fallback, and a swap's off-path prewarm may race on the same
+        # bucket; without the lock both would compile it and the
+        # compiles-flat contract would report phantom recompiles.
         with self._compile_lock:
-            exe = self._executables.get((variant, bucket))
+            exe = gen.executables.get((variant, bucket))
             if exe is not None:
                 return exe
-            forward, variables = self._variants[variant]
+            forward, variables = gen.variants[variant]
             spec = self._jax.ShapeDtypeStruct(
                 (bucket, self.image_size, self.image_size, self.channels),
                 self.input_dtype)
@@ -405,7 +503,7 @@ class InferenceEngine:
                                                     0.0)))
             except Exception:
                 pass
-            self._executables[(variant, bucket)] = exe
+            gen.executables[(variant, bucket)] = exe
             return exe
 
     def profile_waterfall(self):
@@ -430,7 +528,8 @@ class InferenceEngine:
             key = max(keys, key=lambda k: k[1])
             bucket = key[1]
             cached = getattr(self, "_profile_model_wf", None)
-            if cached is None or cached.get("bucket") != bucket:
+            if (cached is None or cached.get("bucket") != bucket
+                    or cached.get("gen") != self._gen.generation):
                 exe = self._executables[key]
                 try:
                     cost = cost_analysis_dict(exe)
@@ -443,8 +542,12 @@ class InferenceEngine:
                     peak=peak_flops(dev),
                     hbm_bytes_per_s=hbm_bandwidth(dev))
                 cached["bucket"] = bucket
-                # HLO parse cached per bucket: scrapes only re-scale it
-                # onto the current measured device phase.
+                cached["gen"] = self._gen.generation
+                # HLO parse cached per (bucket, generation): scrapes
+                # only re-scale it onto the measured device phase; a
+                # hot-swap that prewarmed new executables invalidates
+                # the parse (an aval-matched swap reuses them, so the
+                # generation key is conservative but cheap).
                 self._profile_model_wf = cached
             wf = cached
             meter = self.stats.spans.get("device")
@@ -456,15 +559,171 @@ class InferenceEngine:
         except Exception:
             return None
 
-    def _executable_for(self, variant: str, bucket: int):
-        exe = self._executables.get((variant, bucket))
+    def _executable_for(self, gen: _Generation, variant: str, bucket: int):
+        exe = gen.executables.get((variant, bucket))
         if exe is None:
             # Lazy fallback so an un-warmed engine still works; counted,
             # so the compile-flat-after-warmup test catches any batcher
             # path that would hit this in steady state.
-            return self._compile(variant, bucket)
+            return self._compile(gen, variant, bucket)
         self.stats.record_cache_hit()
         return exe
+
+    # -- atomic hot-swap (docs/serving.md, "Model lifecycle") -----------
+    def swap_weights(self, variables=None, *, variants: Optional[dict]
+                     = None) -> dict:
+        """Atomically replace the serving weights — zero drain, zero
+        dropped requests, by construction.
+
+        ``variables`` is the new default-rung tree; ``variants`` maps
+        each alternate dtype-ladder tag to its new tree (or to a
+        ``(forward, tree)`` pair to replace the rung's forward too).
+        The tag set must cover the configured ladder EXACTLY — the
+        ladder swaps as one unit, because a swap that updated fp32 but
+        left int8 serving the old checkpoint would be a silent
+        split-brain behind one endpoint.
+
+        Executable policy: the AOT executables take variables as call
+        *arguments*, so when every new tree is aval-identical to its
+        incumbent (same structure, shapes, dtypes) and no forward was
+        replaced, the new generation REUSES the incumbent's executable
+        cache — zero recompiles, compile-counter-asserted in
+        tests/test_serve.py.  Otherwise every (variant, bucket)
+        executable is prewarmed here, off the serving path, BEFORE the
+        flip — the incumbent keeps serving through the whole compile.
+
+        The flip itself is one reference assignment.  The batcher reads
+        the generation once per device batch (``_dispatch``), so every
+        in-flight and already-dispatched batch resolves against the old
+        weights and every batch formed after the flip runs the new ones
+        — no queued request is dropped, rejected, or re-run.
+
+        Thread-safe (one swap at a time); callers gate candidates
+        BEFORE calling this (the swap-time admission gates,
+        serve/__main__.py) — by the time a tree reaches here it is
+        traffic-worthy.  Returns a summary dict (generation, digest,
+        reused_executables, prewarmed, duration_s) and publishes a
+        ``swap`` event."""
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            cur = self._gen
+            staged_in: dict = {}
+            if variables is not None:
+                staged_in[self.default_variant] = variables
+            for tag, spec in (variants or {}).items():
+                tag = str(tag)
+                if tag in staged_in:
+                    raise ValueError(f"duplicate swap rung {tag!r}")
+                staged_in[tag] = spec
+            if set(staged_in) != set(cur.variants):
+                raise ValueError(
+                    f"swap must replace the dtype ladder as one unit: "
+                    f"configured rungs {sorted(cur.variants)}, swap "
+                    f"covers {sorted(staged_in)}")
+            replaced_forward = False
+            staged = {}
+            for tag, spec in staged_in.items():
+                if (isinstance(spec, tuple) and len(spec) == 2
+                        and callable(spec[0])):
+                    replaced_forward = True
+                    staged[tag] = (spec[0], spec[1])
+                else:
+                    staged[tag] = (cur.variants[tag][0], spec)
+            digest = _tree_digest(staged[self.default_variant][1])
+            # Stage on device BEFORE the flip: the first post-flip batch
+            # must not pay (or fail) the H2D transfer on the hot path.
+            # Aval compatibility is judged on the STAGED (device) trees
+            # — device_put canonicalizes python-scalar leaves (the int8
+            # marker dicts) exactly the way the lowered executables saw
+            # them, so host-vs-device representation can't spoof a
+            # mismatch.
+            put = {tag: (fwd, self._jax.device_put(tree))
+                   for tag, (fwd, tree) in staged.items()}
+            reused = not replaced_forward and all(
+                _tree_avals(tree) == _tree_avals(cur.variants[tag][1])
+                for tag, (_, tree) in put.items())
+            new_gen = _Generation(
+                put, cur.executables if reused else {},
+                cur.generation + 1, digest)
+            prewarmed = 0
+            if not reused:
+                # Off-path prewarm: compiles land in the NEW
+                # generation's cache while the incumbent generation
+                # keeps serving; counted honestly in stats.compiles
+                # (they are real compiles — just never on the request
+                # path, and never after the flip).
+                for tag in new_gen.variants:
+                    for b in self.buckets:
+                        self._compile(new_gen, tag, b)
+                        prewarmed += 1
+            self._gen = new_gen  # THE flip — one reference, atomic
+            # Stats + event INSIDE the swap lock: a later swap's
+            # record_swap must not land before an earlier one's, or the
+            # exposed generation/digest would disagree with what is
+            # actually serving (swaps are rare control ops — ordering
+            # beats the few extra microseconds of lock hold).
+            duration_s = time.perf_counter() - t0
+            self.stats.record_swap(new_gen.generation, digest)
+            _tm_publish("swap", generation=new_gen.generation,
+                        digest=digest, reused_executables=bool(reused),
+                        prewarmed=prewarmed,
+                        duration_ms=round(1000.0 * duration_s, 3))
+        return {"generation": new_gen.generation, "digest": digest,
+                "reused_executables": bool(reused),
+                "prewarmed": prewarmed,
+                "duration_s": round(duration_s, 4)}
+
+    def candidate_outputs(self, variables, images, *,
+                          variant: Optional[str] = None):
+        """Gate-side evaluation of a swap CANDIDATE tree: run ``images``
+        through the live generation's AOT executables with ``variables``
+        in place of the serving weights (the executables take variables
+        as call arguments), WITHOUT touching what traffic sees.
+
+        This is how the swap-time accuracy gate scores a candidate with
+        zero new compiles: an aval-identical candidate (the hot-swap
+        case) rides the already-warmed (variant, bucket) executables.
+        Raises ValueError when the candidate's avals differ from the
+        live rung's — those candidates prewarm in ``swap_weights``
+        anyway, and the caller gates them post-prewarm.  Returns the
+        forward's pytree with rows matching ``images`` (host arrays)."""
+        variant = (self.default_variant if variant is None
+                   else str(variant))
+        gen = self._gen
+        if variant not in gen.variants:
+            raise ValueError(f"unknown serve dtype {variant!r}; "
+                             f"configured: {sorted(gen.variants)}")
+        # Stage first: device_put canonicalizes python-scalar leaves
+        # (the int8 marker dicts) before the aval comparison, matching
+        # what the lowered executables actually saw.
+        dev_vars = self._jax.device_put(variables)
+        if _tree_avals(dev_vars) != _tree_avals(gen.variants[variant][1]):
+            raise ValueError(
+                f"candidate tree for rung {variant!r} is not "
+                "aval-identical to the serving tree — gate it through "
+                "swap_weights' prewarm path instead")
+        arr = np.asarray(images, self.input_dtype)  # tpuic-ok: TPU101 gate-side eval, not the request path
+        if arr.ndim == 3:
+            arr = arr[None]
+        chunks = []
+        step = self.max_batch
+        for lo in range(0, arr.shape[0], step):
+            chunk = arr[lo:lo + step]
+            n = chunk.shape[0]
+            bucket = self.bucket_for(n)
+            if n < bucket:
+                pad = np.zeros((bucket, self.image_size, self.image_size,
+                                self.channels), self.input_dtype)
+                pad[:n] = chunk
+                chunk = pad
+            exe = self._executable_for(gen, variant, bucket)
+            out = exe(dev_vars, self._jax.device_put(chunk))
+            chunks.append(self._jax.tree.map(
+                lambda a, n=n: np.asarray(a)[:n], out))  # tpuic-ok: TPU101 gate-side eval, not the request path
+        if len(chunks) == 1:
+            return chunks[0]
+        return self._jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=0), *chunks)
 
     # -- request side --------------------------------------------------
     def submit(self, images, *, timeout: Optional[float] = None,
@@ -714,11 +973,27 @@ class InferenceEngine:
             # severity-sweep control run), not the 1 s default.
             time.sleep(
                 1.0 if hang_s is None else float(hang_s))  # tpuic-ok: TPU101 fault param is a host float
+        # ONE generation read per batch (docs/serving.md, "Model
+        # lifecycle"): everything below — executable lookup AND the
+        # variables passed to it — comes from this snapshot, so a
+        # concurrent swap_weights flip lands between batches, never
+        # inside one.  In-flight batches hold their own `out` reference
+        # and resolve against the weights they dispatched with.
+        gen = self._gen
+        if gen.digest != self._boot_digest \
+                and _faults.fire("canary_degrade"):
+            # 'canary_degrade' (runtime/faults.py): a hot-swapped
+            # candidate that serves slower on demand — fires only while
+            # serving non-boot weights, so a fleet-wide arm degrades
+            # exactly the canary and a rollback stands it down.
+            d = _faults.param("canary_degrade")
+            time.sleep(
+                0.05 if d is None else float(d))  # tpuic-ok: TPU101 fault param is a host float
         self.stats.record_dispatch(bucket, rows,
                                    [t_staged - r.t_enqueue for r in reqs])
         variant = reqs[0].variant  # _gather guarantees a pure batch
-        exe = self._executable_for(variant, bucket)
-        out = exe(self._variants[variant][1], self._jax.device_put(batch))
+        exe = self._executable_for(gen, variant, bucket)
+        out = exe(gen.variants[variant][1], self._jax.device_put(batch))
         # Async dispatch: the call returns once work is ENQUEUED; the
         # stamp closes the dispatch span, device time accrues until the
         # readback in _resolve.

@@ -89,6 +89,28 @@ class ServeStats:
         # between load phases keeps the roofline context.
         if not hasattr(self, "executable_cost"):
             self.executable_cost: Dict[int, dict] = {}
+        # Model-lifecycle identity (docs/serving.md, "Model lifecycle"):
+        # like executable_cost, a property of the ENGINE rather than the
+        # measurement window — reset() between load phases must not
+        # erase which weights are serving or how many swaps happened.
+        if not hasattr(self, "swaps"):
+            self.swaps = 0
+            self.generation = 0
+            self.model_digest: str = ""
+
+    def note_identity(self, digest: str, generation: int = 0) -> None:
+        """Record the BOOT weights' identity (engine construction) —
+        no swap happened, the counters stay."""
+        with self._lock:
+            self.model_digest = str(digest)
+            self.generation = int(generation)
+
+    def record_swap(self, generation: int, digest: str) -> None:
+        """One completed atomic hot-swap (engine.swap_weights)."""
+        with self._lock:
+            self.swaps += 1
+            self.generation = int(generation)
+            self.model_digest = str(digest)
 
     # -- engine-side updates -------------------------------------------
     def record_compile(self, bucket: int, seconds: float) -> None:
@@ -212,5 +234,8 @@ class ServeStats:
                                 sorted(self.rejected_by.items())},
                 "executable_cost": {str(k): dict(v) for k, v in
                                     sorted(self.executable_cost.items())},
+                "swaps": self.swaps,
+                "generation": self.generation,
+                "model_digest": self.model_digest,
                 "elapsed_s": round(elapsed, 3),
             }

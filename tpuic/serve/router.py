@@ -74,6 +74,7 @@ import itertools
 import json
 import math
 import os
+import random
 import shlex
 import socket
 import subprocess
@@ -82,7 +83,8 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Callable, Dict, List, Optional, Tuple
+from concurrent.futures import TimeoutError as _FutTimeout
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpuic.runtime.supervisor import _Child, read_heartbeat
 from tpuic.serve import wire
@@ -413,8 +415,24 @@ class _Replica:
         self.reader: Optional[threading.Thread] = None
         self._send_lock = threading.Lock()
         self.inflight: Dict[str, _Request] = {}  # guarded by router._lock
+        # Control-channel futures (swap lines etc.) — guarded by
+        # router._lock, NEVER failed over to a survivor: replaying a
+        # swap on a different replica would flip the wrong process.
+        self.control: Dict[str, Future] = {}
         self.routed = 0
         self.transport_failures = 0
+        # Per-replica outcome ledger (docs/serving.md, "Model
+        # lifecycle"): what THIS replica answered — the canary rollout
+        # driver's health signal (resolved + typed verdicts are
+        # health; untyped errors on a canary trigger rollback).
+        self.resolved = 0
+        self.rejected_typed = 0
+        self.resp_errors = 0
+        # Model identity (ready file at spawn, then live pongs).
+        self.digest: Optional[str] = None
+        self.generation: Optional[int] = None
+        self.dtypes: Optional[Tuple[str, ...]] = None
+        self._digest_flagged = False
         self.breaker = CircuitBreaker(
             threshold=router.breaker_threshold,
             cooldown_s=router.breaker_cooldown_s,
@@ -482,6 +500,13 @@ class _Replica:
             "breaker": self.breaker.snapshot(),
             "inflight": len(self.inflight),
             "routed": self.routed,
+            "resolved": self.resolved,
+            "rejected_typed": self.rejected_typed,
+            "resp_errors": self.resp_errors,
+            "digest": self.digest,
+            "generation": self.generation,
+            "dtypes": (list(self.dtypes) if self.dtypes else None),
+            "digest_ok": not self._digest_flagged,
             "transport_failures": self.transport_failures,
             "live": self.live(now),
             "queue_depth": self.queue_depth,
@@ -597,6 +622,31 @@ class Router:
         self.stats.replica_state_fn = self.replica_health
         self.retry_budget = RetryBudget(ratio=retry_ratio, cap=retry_cap)
         self._lock = threading.Lock()
+        # Model-identity gate (docs/serving.md, "Model lifecycle"): the
+        # first digest a replica reports becomes the fleet's; replicas
+        # reporting a digest outside the allowed set are refused
+        # traffic — hot-swap must not silently open a heterogeneous
+        # fleet.  The rollout driver widens the set (allow_digest)
+        # for the canary's candidate and narrows it again on
+        # promote/rollback (set_fleet_digest / disallow_digest).
+        self.fleet_digest: Optional[str] = None
+        self._allowed_digests: Set[str] = set()
+        # Canary traffic split: (frozenset of replica names, fraction).
+        # None = normal least-loaded routing over the whole fleet.
+        self._split: Optional[Tuple[frozenset, float]] = None
+        # Deterministic-seedable split draw (tests inject their own).
+        self._split_rng = random.Random()
+        # Optional per-outcome hook: fn(replica_name, kind, latency_s)
+        # with kind in ("resolved", "rejected", "error") — the rollout
+        # driver's canary-scoped SLO feed.  Called outside locks;
+        # exceptions contained.
+        self.outcome_hook: Optional[Callable] = None
+        # Digest transitions noted under self._lock, published outside
+        # it (events write files).  A LIST, not a single slot: several
+        # replica reader threads can transition at once (e.g. a
+        # rollback disallowing a digest two replicas still report) and
+        # the ledger must record every one.
+        self._digest_events: List[Tuple[str, str, str, Optional[str]]] = []
         self._ledger_lock = threading.Lock()
         self.ledger_path = ledger_path or os.path.join(
             self.state_dir, "router_ledger.jsonl")
@@ -626,6 +676,125 @@ class Router:
                 rep = _Replica(idx, self, cmd=list(replica_cmd))
                 self.replicas.append(rep)
                 idx += 1
+
+    # -- model identity / canary split ----------------------------------
+    def allow_digest(self, digest: str) -> None:
+        """Authorize a second model digest fleet-wide (the rollout
+        driver calls this for the canary's candidate BEFORE shifting
+        traffic to it)."""
+        with self._lock:
+            self._allowed_digests.add(str(digest))
+        self._publish("router_replica", replica="*",
+                      action="digest_allow", digest=str(digest))
+
+    def disallow_digest(self, digest: str) -> None:
+        """Withdraw a digest's authorization (rollback): replicas still
+        reporting it are refused traffic until they swap back — even a
+        failed swap-back cannot leak candidate predictions."""
+        with self._lock:
+            self._allowed_digests.discard(str(digest))
+        self._publish("router_replica", replica="*",
+                      action="digest_disallow", digest=str(digest))
+
+    def set_fleet_digest(self, digest: str) -> None:
+        """Promotion: the candidate digest becomes THE fleet digest and
+        the only authorized one."""
+        with self._lock:
+            self.fleet_digest = str(digest)
+            self._allowed_digests = {str(digest)}
+        self._publish("router_replica", replica="*",
+                      action="fleet_digest", digest=str(digest))
+
+    def set_traffic_split(self, canaries, fraction: float) -> None:
+        """Route ``fraction`` of pick decisions to the named canary
+        replicas, the rest to everyone else (least-loaded within each
+        group).  A group with no routable member falls back to the
+        other — availability beats split fidelity mid-rollout."""
+        names = frozenset(str(n) for n in canaries)
+        frac = min(1.0, max(0.0, float(fraction)))
+        with self._lock:
+            self._split = (names, frac)
+        self._publish("router_replica", replica="*", action="split",
+                      canaries=sorted(names), fraction=frac)
+
+    def clear_traffic_split(self) -> None:
+        with self._lock:
+            self._split = None
+        self._publish("router_replica", replica="*", action="split_clear")
+
+    def _note_digest_locked(self, rep: _Replica) -> None:
+        """Adopt / flag a replica's reported digest (caller holds
+        ``self._lock``).  First digest seen becomes the fleet's; a
+        digest outside the allowed set flags the replica (refused by
+        ``_pick``) until it matches again or the set widens."""
+        d = rep.digest
+        if d is None:
+            return
+        if self.fleet_digest is None:
+            self.fleet_digest = d
+            self._allowed_digests.add(d)
+        flagged = d not in self._allowed_digests
+        if flagged != rep._digest_flagged:
+            rep._digest_flagged = flagged
+            self._digest_events.append(
+                (rep.name, "digest_mismatch" if flagged else "digest_ok",
+                 d, self.fleet_digest))
+
+    def _flush_digest_event(self) -> None:
+        """Publish digest transitions recorded under the lock (events
+        write files — never inside ``self._lock``)."""
+        with self._lock:
+            if not self._digest_events:
+                return
+            evs, self._digest_events = self._digest_events, []
+        for name, action, digest, fleet in evs:
+            self._publish("router_replica", replica=name, action=action,
+                          digest=digest, fleet_digest=fleet)
+            if action == "digest_mismatch":
+                self._log(f"{name}: MODEL DIGEST MISMATCH ({digest} not "
+                          f"in allowed set; fleet {fleet}) — refusing "
+                          "to route to it")
+
+    # -- control channel ------------------------------------------------
+    def control_request(self, replica: str, payload: dict,
+                        timeout_s: float = 120.0) -> dict:
+        """One control line (e.g. ``{"op": "swap", ...}``) to the NAMED
+        replica; blocks for its keyed response.
+
+        Control requests are deliberately OUTSIDE the failover path:
+        they are never replayed on a survivor (a swap replayed on a
+        different replica would flip the wrong process), never counted
+        in the offered-traffic ledger, and a replica death mid-request
+        raises :class:`ReplicaLost`.  A wire error record raises its
+        rebuilt typed exception — a gate's ``SwapRejected`` crosses the
+        socket intact (tpuic/serve/wire.py)."""
+        rep = None
+        for r in self.replicas:
+            if r.name == str(replica):
+                rep = r
+                break
+        if rep is None:
+            raise ValueError(f"no replica named {replica!r} "
+                             f"(have: {[r.name for r in self.replicas]})")
+        wire_id = f"c{next(self._wire_ids)}"
+        fut: Future = Future()
+        with self._lock:
+            rep.control[wire_id] = fut
+        try:
+            rep.send_line({**payload, "id": wire_id})
+        except OSError as e:
+            with self._lock:
+                rep.control.pop(wire_id, None)
+            self._on_replica_down(rep, f"control send: {e}")
+            raise ReplicaLost(f"control send to {rep.name} failed: {e}")
+        try:
+            return fut.result(timeout=timeout_s)
+        except _FutTimeout:
+            with self._lock:
+                rep.control.pop(wire_id, None)
+            raise TimeoutError(
+                f"control request to {rep.name} timed out after "
+                f"{timeout_s:g}s (op={payload.get('op')!r})") from None
 
     # -- telemetry ------------------------------------------------------
     def _publish(self, kind: str, **data) -> None:
@@ -750,6 +919,16 @@ class Router:
             images, line = None, images
         payload: dict = dict(line or {})
         payload.pop("id", None)
+        if payload.get("op") is not None:
+            # Control lines (swap, ping) must NEVER ride the data path:
+            # submit() failover-replays idempotent requests onto
+            # survivors — a replayed swap would flip a replica nobody
+            # named — and an unauthenticated front-end forwarding raw
+            # lines here must not be a one-line weight flip.  The only
+            # control channel is Router.control_request.
+            raise ValueError(
+                f"control line op={payload['op']!r} cannot ride the "
+                "data path — use Router.control_request")
         if images is not None:
             payload.update(wire.encode_array(images))
         if priority != DEFAULT_PRIORITY or "priority" in payload:
@@ -835,13 +1014,42 @@ class Router:
 
     def _pick(self, priority: str
               ) -> Tuple[Optional[_Replica], Optional[str]]:
-        """Least-loaded shed-aware selection (module docstring)."""
+        """Least-loaded shed-aware selection (module docstring), behind
+        the model-identity gate and the canary traffic split."""
         now = time.monotonic()
         with self._lock:
             cands = [r for r in self.replicas
                      if r.state == UP and r.live(now)]
             if not cands:
                 return None, "no live replica"
+            # Model-identity gate (docs/serving.md, "Model lifecycle"):
+            # a replica reporting a digest outside the allowed set is
+            # refused — a hot-swap mid-rollout must not silently serve
+            # unauthorized weights.  Unknown digests (no signal yet,
+            # pre-identity replicas) pass: the gate refuses proven
+            # heterogeneity, it does not demand proof of homogeneity.
+            gated = [r for r in cands if not r._digest_flagged]
+            if not gated:
+                return None, (f"all {len(cands)} live replicas refused: "
+                              "model digest outside the allowed set")
+            cands = gated
+            split = self._split
+            if split is not None:
+                names, frac = split
+                canary = [r for r in cands if r.name in names]
+                rest = [r for r in cands if r.name not in names]
+                if canary and rest:
+                    at_limit = lambda grp: all(  # noqa: E731
+                        len(r.inflight) >= r.spill_limit() for r in grp)
+                    chosen = (canary if self._split_rng.random() < frac
+                              else rest)
+                    other = rest if chosen is canary else canary
+                    # Availability over split fidelity: a group at its
+                    # spill limit falls back to the other instead of
+                    # shedding while capacity idles.
+                    if at_limit(chosen) and not at_limit(other):
+                        chosen = other
+                    cands = chosen
             ranked = sorted(
                 cands, key=lambda r: (r.sheds(priority),
                                       len(r.inflight) >= r.spill_limit(),
@@ -943,16 +1151,44 @@ class Router:
             rep.last_pong = time.monotonic()
             if rec.get("queue_depth") is not None:
                 rep.queue_depth = int(rec["queue_depth"])
+            if rec.get("generation") is not None:
+                rep.generation = int(rec["generation"])
+            if rec.get("digest") is not None:
+                # Live model identity: a hot-swap shows up here within
+                # one ping interval, and the identity gate reacts
+                # before the next pick.
+                with self._lock:
+                    rep.digest = str(rec["digest"])
+                    self._note_digest_locked(rep)
+                self._flush_digest_event()
             return
         wire_id = rec.get("id")
         with self._lock:
-            req = rep.inflight.pop(wire_id, None)
+            ctl = (rep.control.pop(wire_id, None)
+                   if wire_id is not None else None)
+            req = (rep.inflight.pop(wire_id, None)
+                   if ctl is None else None)
+        if ctl is not None:
+            # Control-channel outcome (swap lines): typed errors rebuild
+            # to the exception the in-process gate would have raised;
+            # breaker credit applies (the transport worked), the
+            # offered-traffic ledger is untouched.
+            rep.breaker.record_success()
+            if ctl.done():
+                return
+            if "error" in rec:
+                ctl.set_exception(wire.rebuild_error(rec))
+            else:
+                ctl.set_result(dict(rec))
+            return
         if req is None:
-            if (isinstance(wire_id, str) and wire_id.startswith("q")
+            if (isinstance(wire_id, str) and wire_id[:1] in ("q", "c")
                     and wire_id[1:].isdigit()):
-                # An id this router issued, no longer in flight: a late
-                # duplicate (e.g. the original response raced a
-                # failover replay).  At-most-once = first wins.
+                # An id this router issued (request or control), no
+                # longer in flight: a late duplicate (e.g. the original
+                # response raced a failover replay, or a control
+                # response landed after its timeout).  At-most-once =
+                # first wins.
                 self.stats.record_duplicate()
             else:
                 # An id we never issued (a replica's id-less
@@ -970,19 +1206,38 @@ class Router:
             from tpuic.serve.admission import AdmissionError
             if isinstance(exc, AdmissionError):
                 self.stats.record_reject(exc.cause, req.priority)
+                rep.rejected_typed += 1
+                self._outcome(rep.name, "rejected", None)
             else:
                 self.stats.record_error()
+                rep.resp_errors += 1
+                self._outcome(rep.name, "error", None)
             req.future.set_exception(exc)
             return
         out = dict(rec)
         out["id"] = req.client_id
         out["replica"] = rep.name
-        self.stats.record_resolved(time.monotonic() - req.t_offered)
+        latency_s = time.monotonic() - req.t_offered
+        self.stats.record_resolved(latency_s)
+        rep.resolved += 1
         if req.attempts > 1:
             # The outcome hook contract loadgen.run_stream consumes:
             # replayed requests stamp their retry count on the future.
             req.future.tpuic_retries = req.attempts - 1
         req.future.set_result(out)
+        self._outcome(rep.name, "resolved", latency_s)
+
+    def _outcome(self, replica: str, kind: str,
+                 latency_s: Optional[float]) -> None:
+        """Invoke the optional per-outcome hook (rollout driver's
+        canary-scoped SLO feed) — contained, outside locks."""
+        hook = self.outcome_hook
+        if hook is None:
+            return
+        try:
+            hook(replica, kind, latency_s)
+        except Exception:  # a monitoring hook must never kill routing
+            pass
 
     # -- failure handling -----------------------------------------------
     def _on_replica_down(self, rep: _Replica, reason: str) -> None:
@@ -993,11 +1248,21 @@ class Router:
             rep.state = DOWN
             orphans = list(rep.inflight.values())
             rep.inflight.clear()
+            controls = list(rep.control.values())
+            rep.control.clear()
             rep.respawn_at = (time.monotonic() + self.respawn_backoff_s
                               * (2.0 ** min(6, rep.consecutive_spawn_failures)))
         rep.close_socket()
         rep.transport_failures += 1
         rep.breaker.trip(f"connection lost: {reason}")
+        for ctl in controls:
+            # Control requests never fail over (a swap replayed on a
+            # survivor would flip the wrong replica): the caller gets
+            # the typed loss verdict and decides.
+            if not ctl.done():
+                ctl.set_exception(ReplicaLost(
+                    f"replica {rep.name} lost mid-control-request "
+                    f"({reason})"))
         requeued = lost = 0
         for req in orphans:
             if req.future.done():
@@ -1219,6 +1484,18 @@ class Router:
         rep.addr = ("127.0.0.1", int(port))
         if ready.get("prom_port"):
             rep.prom_port = int(ready["prom_port"])
+        if ready.get("dtypes"):
+            rep.dtypes = tuple(str(t) for t in ready["dtypes"])
+        if ready.get("generation") is not None:
+            rep.generation = int(ready["generation"])
+        if ready.get("digest"):
+            # Boot identity from the handoff (live identity rides the
+            # pongs): the heterogeneous-fleet gate engages before the
+            # first request is ever routed to this replica.
+            with self._lock:
+                rep.digest = str(ready["digest"])
+                self._note_digest_locked(rep)
+            self._flush_digest_event()
         if self._try_connect(rep):
             rep.consecutive_spawn_failures = 0
 
@@ -1258,10 +1535,17 @@ class Router:
         return {rep.name: rep.health() for rep in self.replicas}
 
     def snapshot(self) -> dict:
-        """Stats + retry budget + per-replica health, one JSON-able
-        dict (the prom exposition's input)."""
+        """Stats + retry budget + per-replica health + model-identity
+        state, one JSON-able dict (the prom exposition's input)."""
         out = self.stats.snapshot()
         out["retry_budget"] = self.retry_budget.state()
+        with self._lock:
+            out["fleet_digest"] = self.fleet_digest
+            out["allowed_digests"] = sorted(self._allowed_digests)
+            split = self._split
+        out["traffic_split"] = (
+            {"canaries": sorted(split[0]), "fraction": split[1]}
+            if split is not None else None)
         return out
 
     # -- drain / close ---------------------------------------------------
@@ -1343,6 +1627,89 @@ class Router:
 
 
 # -- CLI ---------------------------------------------------------------------
+def pump_stdin(handle: Callable[[str], None], guard,
+               beat: Optional[Callable[[], None]] = None) -> None:
+    """Select-gated raw stdin pump (the serve driver's idiom: PEP 475
+    would resume a blocked readline right through SIGTERM; raw os.read
+    because TextIOWrapper buffering hides burst-written lines from
+    select).  Shared by the router CLI and the rollout CLI — one
+    implementation of the accept loop, not three.  ``handle`` gets each
+    complete line; ``beat`` ticks the supervised-liveness heartbeat."""
+    import select
+    try:
+        stdin_fd = sys.stdin.fileno()
+    except (ValueError, OSError, AttributeError):
+        stdin_fd = None
+    if stdin_fd is None:
+        for line in sys.stdin:
+            if guard.triggered:
+                return
+            handle(line)
+        return
+    tail = b""
+    while not guard.triggered:
+        try:
+            ready, _, _ = select.select([stdin_fd], [], [], 0.2)
+        except (OSError, ValueError):
+            return
+        if beat is not None:
+            beat()
+        if not ready:
+            continue
+        chunk = os.read(stdin_fd, 1 << 16)
+        if not chunk:
+            break  # EOF
+        *lines, tail = (tail + chunk).split(b"\n")
+        for raw in lines:
+            handle(raw.decode("utf-8", "replace"))
+    if tail.strip() and not guard.triggered:
+        handle(tail.decode("utf-8", "replace"))
+
+
+def make_line_handler(router: Router, out, out_lock: threading.Lock
+                      ) -> Callable[[str], None]:
+    """Client-line handler for the stdin CLIs (router and rollout):
+    parse one JSONL request line, route it, write the outcome (result
+    record or typed error line) to ``out`` under ``out_lock``.  One
+    implementation so the two CLIs cannot drift on the wire shape."""
+
+    def emit_outcome(rid: str, fut) -> None:
+        try:
+            rec = fut.result()
+            line = json.dumps({**rec, "id": rid}) + "\n"
+        except Exception as e:  # noqa: BLE001 — typed via the one encoder
+            line = wire.error_line(rid, e)
+        with out_lock:
+            out.write(line)
+            out.flush()
+
+    def handle(line: str) -> None:
+        line = line.strip()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError
+        except ValueError:
+            with out_lock:
+                out.write(wire.error_line(
+                    None, f"bad request line: {line[:80]}"))
+                out.flush()
+            return
+        try:
+            rid, fut = router.submit_line(req)
+        except (ValueError, TypeError) as e:
+            with out_lock:
+                out.write(wire.error_line(
+                    str(req.get("id", "?")), e))
+                out.flush()
+            return
+        fut.add_done_callback(lambda f, rid=rid: emit_outcome(rid, f))
+
+    return handle
+
+
 def main(argv=None) -> int:
     """``python -m tpuic.serve.router`` — stdin-JSONL in, fleet out.
 
@@ -1386,6 +1753,16 @@ def main(argv=None) -> int:
     p.add_argument("--wedge-timeout-s", type=float, default=15.0)
     p.add_argument("--spawn-timeout-s", type=float, default=300.0)
     p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--http-port", type=int, default=0,
+                   help="HTTP front-end (tpuic/serve/http.py): POST "
+                        "/predict with typed-verdict 429/503 mapping + "
+                        "Retry-After, GET /healthz, GET /metrics. "
+                        "0 disables; -1 binds a kernel-assigned port "
+                        "(logged)")
+    p.add_argument("--http-host", default="127.0.0.1",
+                   help="interface for --http-port (loopback default — "
+                        "unauthenticated; bind 0.0.0.0 only behind a "
+                        "firewall/load balancer)")
     p.add_argument("--prom-port", type=int, default=0,
                    help="serve the router's own tpuic_router_* "
                         "/metrics exposition on this port (0 disables)")
@@ -1440,82 +1817,32 @@ def main(argv=None) -> int:
             host=args.prom_host)
         print(f"[router] prometheus /metrics on "
               f"{args.prom_host}:{prom_server.port}", file=sys.stderr)
+    http_server = None
+    if args.http_port:
+        from tpuic.serve.http import RouterHTTPServer
+        http_server = RouterHTTPServer(router, port=max(0, args.http_port),
+                                       host=args.http_host)
+        print(f"[router] http front-end on "
+              f"{args.http_host}:{http_server.port} "
+              "(POST /predict, GET /healthz, GET /metrics)",
+              file=sys.stderr)
 
     out = open(args.out, "w") if args.out else sys.stdout
     out_lock = threading.Lock()
+    handle = make_line_handler(router, out, out_lock)
 
-    def emit_outcome(rid: str, fut) -> None:
-        try:
-            rec = fut.result()
-            line = json.dumps({**rec, "id": rid}) + "\n"
-        except Exception as e:  # noqa: BLE001 — typed via the one encoder
-            line = wire.error_line(rid, e)
-        with out_lock:
-            out.write(line)
-            out.flush()
-
-    def handle(line: str) -> None:
-        line = line.strip()
-        if not line:
-            return
-        try:
-            req = json.loads(line)
-            if not isinstance(req, dict):
-                raise ValueError
-        except ValueError:
-            with out_lock:
-                out.write(wire.error_line(
-                    None, f"bad request line: {line[:80]}"))
-                out.flush()
-            return
-        try:
-            rid, fut = router.submit_line(req)
-        except (ValueError, TypeError) as e:
-            with out_lock:
-                out.write(wire.error_line(
-                    str(req.get("id", "?")), e))
-                out.flush()
-            return
-        fut.add_done_callback(lambda f, rid=rid: emit_outcome(rid, f))
-
-    # select-gated raw stdin reads (the serve driver's idiom: PEP 475
-    # would resume a blocked readline right through SIGTERM).
-    import select
     try:
-        stdin_fd = sys.stdin.fileno()
-    except (ValueError, OSError, AttributeError):
-        stdin_fd = None
-    try:
-        if stdin_fd is None:
-            for line in sys.stdin:
-                if guard.triggered:
-                    break
-                handle(line)
-        else:
-            tail = b""
-            while not guard.triggered:
-                try:
-                    ready, _, _ = select.select([stdin_fd], [], [], 0.2)
-                except (OSError, ValueError):
-                    break
-                if heartbeat is not None:
-                    heartbeat.beat()
-                if not ready:
-                    continue
-                chunk = os.read(stdin_fd, 1 << 16)
-                if not chunk:
-                    break  # EOF
-                *lines, tail = (tail + chunk).split(b"\n")
-                for raw in lines:
-                    handle(raw.decode("utf-8", "replace"))
-            if tail.strip() and not guard.triggered:
-                handle(tail.decode("utf-8", "replace"))
+        pump_stdin(handle, guard,
+                   beat=(heartbeat.beat if heartbeat is not None
+                         else None))
     except KeyboardInterrupt:
         pass
     finally:
         guard.uninstall()
         stragglers = router.drain(args.drain_timeout)
         router.close(drain=False)
+        if http_server is not None:
+            http_server.close()
         if prom_server is not None:
             prom_server.close()
         if args.prom_dump:

@@ -63,7 +63,7 @@ def rebuild_error(record: dict) -> Exception:
     a local engine or crossed a socket.  Untyped records (decode
     failures, drain timeouts) become plain RuntimeError."""
     from tpuic.serve.admission import (AdmissionRejected, DeadlineExceeded,
-                                       ReplicaLost)
+                                       ReplicaLost, SwapRejected)
     msg = str(record.get("error", "unknown error"))
     cause = record.get("cause")
     if cause is None:
@@ -75,6 +75,11 @@ def rebuild_error(record: dict) -> Exception:
     if cause == "replica_lost":
         return ReplicaLost(msg, priority=priority,
                            tenant=record.get("tenant"))
+    if cause in ("swap_corrupt", "swap_accuracy"):
+        # Swap-gate refusal crossing the wire (the rollout driver's
+        # control channel): same typed exception as an in-process gate.
+        return SwapRejected(msg, cause=cause, priority=priority,
+                            tenant=record.get("tenant"))
     return AdmissionRejected(msg, cause=cause, priority=priority,
                              tenant=record.get("tenant"))
 

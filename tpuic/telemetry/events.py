@@ -49,7 +49,14 @@ EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                # per-replica lifecycle/health transitions, circuit-breaker
                # state changes, budgeted retries, and in-flight failover.
                "router_replica", "router_breaker", "router_retry",
-               "router_failover")
+               "router_failover",
+               # Model-lifecycle tier (docs/serving.md, "Model
+               # lifecycle: hot-swap, canary, rollback"): one 'swap'
+               # event per engine weight flip (generation, digest,
+               # executable reuse vs prewarm), one 'rollout' event per
+               # canary-rollout transition (start/stage/rollback/
+               # promote/refused — tpuic/serve/rollout.py).
+               "swap", "rollout")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -233,6 +233,11 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
          "cumulative compile wall time", None),
         ("pad_efficiency", snapshot.get("pad_efficiency"), "gauge",
          "valid rows / device rows (1.0 = no padding waste)", None),
+        ("swaps_total", snapshot.get("swaps"), "counter",
+         "atomic weight hot-swaps completed (docs/serving.md, "
+         "'Model lifecycle')", None),
+        ("generation", snapshot.get("generation"), "gauge",
+         "weight generation: 0 at boot, +1 per hot-swap", None),
         ("throughput_images_per_sec", snapshot.get(
             "throughput_images_per_sec"), "gauge",
          "lifetime images/sec", None),
@@ -272,6 +277,13 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
             if c.get(field) is not None:
                 rows.append((f"executable_{field}", c[field], "gauge",
                              help_, labels))
+    if snapshot.get("model_digest"):
+        # Info-style row (value 1, identity in the label): what weights
+        # are serving — scrape-join it against the router's view.
+        rows.append(("model_info", 1, "gauge",
+                     "serving-weights identity (digest label; "
+                     "generation row says how many swaps ago)",
+                     {"digest": str(snapshot["model_digest"])}))
     rows.extend(profile_rows(profile))
     rows.extend(admission_rows(snapshot, admission))
     rows.extend(memory_rows(memory))
@@ -284,8 +296,48 @@ _REPLICA_STATE_CODE = {"starting": 0.0, "up": 1.0, "wedged": 2.0,
                        "down": 3.0, "failed": 4.0, "stopped": 5.0}
 
 
+_ROLLOUT_PHASE_CODE = {"idle": 0.0, "gating": 1.0, "canary": 2.0,
+                       "promoting": 3.0, "rolling_back": 4.0,
+                       "promoted": 5.0, "rolled_back": 6.0,
+                       "refused": 7.0, "aborted": 8.0}
+
+
+def rollout_rows(rollout: Optional[dict]) -> List[Tuple]:
+    """``CanaryRollout.state()`` -> tpuic_rollout_* rows
+    (tpuic/serve/rollout.py, docs/serving.md "Model lifecycle").
+    Phase is a numeric code (0=idle 1=gating 2=canary 3=promoting
+    4=rolling_back 5=promoted 6=rolled_back 7=refused 8=aborted) so a
+    dashboard alerts on 4+/6+ without string matching."""
+    if not rollout:
+        return []
+    rows: List[Tuple] = [
+        ("rollout_phase", _ROLLOUT_PHASE_CODE.get(rollout.get("phase")),
+         "gauge", "rollout phase (0=idle 1=gating 2=canary 3=promoting "
+         "4=rolling_back 5=promoted 6=rolled_back 7=refused 8=aborted)",
+         None),
+        ("rollout_stage_index", rollout.get("stage_index"), "gauge",
+         "current canary stage index (-1 before the first stage)",
+         None),
+        ("rollout_stage_fraction", rollout.get("stage_fraction"),
+         "gauge", "fraction of traffic routed to the canary", None),
+        ("rollout_canary_errors_total", rollout.get("canary_errors"),
+         "counter", "untyped errors observed on the canary (any one "
+         "triggers rollback)", None),
+    ]
+    if rollout.get("objective"):
+        labels = {"slo": str(rollout["objective"])}
+        rows.append(("rollout_burn_rate", rollout.get("burn_rate"),
+                     "gauge", "canary-scoped error-budget burn rate of "
+                     "the watched objective", labels))
+        rows.append(("rollout_canary_window_samples",
+                     rollout.get("canary_window_samples"), "gauge",
+                     "canary latency samples in the SLO window", labels))
+    return rows
+
+
 def router_exposition(snapshot: dict,
-                      prefix: str = "tpuic_router") -> str:
+                      prefix: str = "tpuic_router",
+                      rollout: Optional[dict] = None) -> str:
     """``Router.snapshot()`` -> Prometheus text (tpuic/serve/router.py,
     docs/serving.md "Replica routing and failover").
 
@@ -294,9 +346,11 @@ def router_exposition(snapshot: dict,
     latency quantiles, and per-replica rows — health state and breaker
     state as numeric codes (state: 0=starting 1=up 2=wedged 3=down
     4=failed 5=stopped; breaker: 0=closed 0.5=half_open 1=open) so a
-    dashboard can alert on a replica leaving 1/0.  Deliberately no
-    ``process_rss_bytes`` row: that helper imports the jax-backed
-    metrics stack, and the router process is stdlib-only by contract."""
+    dashboard can alert on a replica leaving 1/0.  ``rollout`` appends
+    the tpuic_rollout_* rows (:func:`rollout_rows`) when a canary
+    rollout driver is attached.  Deliberately no ``process_rss_bytes``
+    row: that helper imports the jax-backed metrics stack, and the
+    router process is stdlib-only by contract."""
     rows: List[Tuple] = [
         ("offered_total", snapshot.get("offered"), "counter",
          "requests offered to the router", None),
@@ -377,6 +431,41 @@ def router_exposition(snapshot: dict,
         rows.append(("replica_spawns_total", rep.get("spawns"),
                      "counter", "times this replica was (re)spawned",
                      labels))
+        rows.append(("replica_generation", rep.get("generation"),
+                     "gauge", "replica weight generation (0 at boot, "
+                     "+1 per hot-swap; from the live pong)", labels))
+        rows.append(("replica_resolved_total", rep.get("resolved"),
+                     "counter", "requests this replica resolved with a "
+                     "result", labels))
+        rows.append(("replica_typed_rejects_total",
+                     rep.get("rejected_typed"), "counter",
+                     "typed verdicts this replica returned", labels))
+        rows.append(("replica_errors_total", rep.get("resp_errors"),
+                     "counter", "untyped error responses from this "
+                     "replica (the canary rollback trigger)", labels))
+        rows.append(("replica_digest_ok",
+                     (None if rep.get("digest") is None
+                      else float(bool(rep.get("digest_ok")))), "gauge",
+                     "1 = replica's model digest is in the fleet's "
+                     "allowed set, 0 = refused traffic by the identity "
+                     "gate (absent until the replica reports one)",
+                     labels))
+        if rep.get("digest"):
+            rows.append(("replica_model_info", 1, "gauge",
+                         "replica serving-weights identity (digest "
+                         "label)", {**labels,
+                                    "digest": str(rep["digest"])}))
+    if snapshot.get("fleet_digest"):
+        rows.append(("fleet_model_info", 1, "gauge",
+                     "THE fleet model digest the identity gate "
+                     "enforces (docs/serving.md, 'Model lifecycle')",
+                     {"digest": str(snapshot["fleet_digest"])}))
+    split = snapshot.get("traffic_split")
+    rows.append(("traffic_split_fraction",
+                 (split or {}).get("fraction"), "gauge",
+                 "fraction of picks routed to the canary group (absent "
+                 "outside a rollout)", None))
+    rows.extend(rollout_rows(rollout))
     return render(rows, prefix=prefix)
 
 
