@@ -1,0 +1,633 @@
+"""Elastic data parallelism (ISSUE 15): ZeRO-sharded optimizer state that
+reshards across replica counts on restore, and gang membership that
+treats rank loss as a degrade event — plus the satellites riding along
+(the membership file protocol, the ``rank_rejoin_flap`` fault point, the
+fleet aggregator's ``--membership`` timeline gate, the replica-mesh
+constructor).
+
+Like tests/test_gang.py, the gang-level tests run REAL child processes
+that import only ``tpuic.runtime.supervisor`` (stdlib-only, bare
+interpreter starts). The full-fat end-to-end — real train.py ranks, a
+real mid-epoch SIGKILL, survivors re-forming with pinned pids, bitwise
+convergence parity against an undisturbed baseline — is
+``scripts/elastic_soak.py``, CI-gated next to this suite."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuic.runtime.gang import GangSupervisor
+from tpuic.runtime.membership import (ENV_MEMBERSHIP_FILE, Membership,
+                                      MembershipWatcher, read_membership,
+                                      write_membership)
+from tpuic.runtime.supervisor import (EXIT_BELOW_MIN, EXIT_POISON,
+                                      EXIT_PREEMPTED)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- membership file protocol ------------------------------------------------
+def test_membership_roundtrip_and_torn_read(tmp_path):
+    path = str(tmp_path / "membership.json")
+    m = Membership(version=3, world=4, active=[0, 2, 3], resume_step=17,
+                   reason="degrade", rank=1, t=123.0)
+    write_membership(path, m)
+    got = read_membership(path)
+    assert got == m and got.replicas == 3
+    # A torn/garbage file reads as None, never a crash.
+    with open(path, "w") as f:
+        f.write('{"version": 3, "wor')
+    assert read_membership(path) is None
+    assert read_membership(str(tmp_path / "absent.json")) is None
+    with pytest.raises(ValueError):
+        write_membership(path, Membership(1, 2, [0], None, "bogus"))
+
+
+def test_membership_watcher_swallows_init_and_surfaces_each_version_once(
+        tmp_path):
+    path = str(tmp_path / "membership.json")
+    write_membership(path, Membership(1, 2, [0, 1], None, "init"))
+    w = MembershipWatcher(path)
+    # The spawn-time view is not a transition.
+    assert w.poll() is None
+    assert w.current is not None and w.current.version == 1
+    write_membership(path, Membership(2, 2, [0], 5, "degrade", rank=1))
+    m = w.poll()
+    assert m is not None and m.version == 2 and m.resume_step == 5
+    # Surfaced exactly once; unchanged file costs only a stat.
+    assert w.poll() is None
+    # A rewrite with the SAME version (idempotent republish) is not new.
+    write_membership(path, Membership(2, 2, [0], 5, "degrade", rank=1))
+    assert w.poll() is None
+    write_membership(path, Membership(3, 2, [0, 1], None, "rejoin", rank=1))
+    assert w.poll().version == 3
+
+
+def test_membership_watcher_counts_coalesced_versions(tmp_path):
+    """The file holds only the latest view, so a degrade overwritten by
+    its rejoin before a reader polled COALESCES: the watcher surfaces
+    the rejoin with ``skipped`` counting the lost versions — the
+    trainer's cue (with the cap the rejoin record carries,
+    runtime/gang.py) to restore anyway instead of training ahead of a
+    re-form it never saw."""
+    path = str(tmp_path / "membership.json")
+    write_membership(path, Membership(1, 2, [0, 1], None, "init"))
+    w = MembershipWatcher(path)
+    # Normal cadence: nothing skipped.
+    write_membership(path, Membership(2, 2, [0], 5, "degrade", rank=1))
+    assert w.poll().version == 2 and w.skipped == 0
+    write_membership(path, Membership(3, 2, [0, 1], 5, "rejoin", rank=1))
+    assert w.poll().version == 3 and w.skipped == 0
+    # Coalesced: v4 (degrade) and v5 (rejoin) land between polls.
+    write_membership(path, Membership(4, 2, [0], 9, "degrade", rank=1))
+    write_membership(path, Membership(5, 2, [0, 1], 9, "rejoin", rank=1))
+    m = w.poll()
+    assert m.version == 5 and m.reason == "rejoin"
+    assert w.skipped == 1 and m.resume_step == 9
+
+
+def test_membership_watcher_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_MEMBERSHIP_FILE, raising=False)
+    assert MembershipWatcher.from_env() is None
+    path = str(tmp_path / "m.json")
+    monkeypatch.setenv(ENV_MEMBERSHIP_FILE, path)
+    w = MembershipWatcher.from_env()
+    assert w is not None and w.poll() is None   # file may not exist yet
+    write_membership(path, Membership(1, 2, [0, 1], None, "init"))
+    # First-ever view after a file-less start IS surfaced (the watcher
+    # only swallows a view that existed at construction).
+    assert w.poll().version == 1
+
+
+def test_data_parallel_replicas_sources(tmp_path, monkeypatch):
+    from tpuic.runtime.distributed import data_parallel_replicas
+    monkeypatch.delenv(ENV_MEMBERSHIP_FILE, raising=False)
+    monkeypatch.delenv("TPUIC_FLEET_RANKS", raising=False)
+    assert data_parallel_replicas() == jax.process_count()
+    monkeypatch.setenv("TPUIC_FLEET_RANKS", "4")
+    assert data_parallel_replicas() == 4
+    path = str(tmp_path / "m.json")
+    write_membership(path, Membership(2, 4, [0, 2, 3], 9, "degrade", 1))
+    monkeypatch.setenv(ENV_MEMBERSHIP_FILE, path)
+    assert data_parallel_replicas() == 3   # live membership wins
+
+
+# -- replica mesh ------------------------------------------------------------
+def test_replica_mesh_subsets_devices(devices8):
+    from tpuic.config import MeshConfig
+    from tpuic.runtime.mesh import replica_mesh
+    for r in (1, 2, 4, 8):
+        mesh = replica_mesh(r)
+        assert mesh.shape["data"] == r and mesh.size == r
+        assert list(mesh.devices.flat) == devices8[:r]
+    # Inner (seq/model) axes ride along per replica slot.
+    mesh = replica_mesh(2, MeshConfig(model=2))
+    assert dict(mesh.shape) == {"data": 2, "seq": 1, "model": 2}
+    with pytest.raises(ValueError):
+        replica_mesh(0)
+    with pytest.raises(ValueError):
+        replica_mesh(9)   # 9 > 8 devices
+
+
+# -- ZeRO-sharded optimizer checkpoint resharding ----------------------------
+class _Tiny:
+    """Deferred import wrapper so flax only loads inside the test."""
+
+    @staticmethod
+    def build():
+        import flax.linen as nn
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                x = x.reshape((x.shape[0], -1))
+                x = nn.relu(nn.Dense(128)(x))
+                return nn.Dense(8)(x)
+
+        return Tiny()
+
+
+def _tiny_state(key=0):
+    from tpuic.config import OptimConfig
+    from tpuic.train.optimizer import make_optimizer
+    from tpuic.train.state import create_train_state
+    ocfg = OptimConfig(optimizer="adam", learning_rate=1e-3,
+                       class_weights=(), milestones=())
+    return create_train_state(_Tiny.build(), make_optimizer(ocfg),
+                              jax.random.key(key), (2, 4, 4, 3))
+
+
+def _tree_rand(tree, seed):
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), p.dtype), tree)
+
+
+def _zero1_state(state, mesh):
+    from tpuic.parallel.sharding import shard_state, state_shardings
+    sh = state_shardings(state, mesh, tp=False, fsdp=False, zero1=True)
+    return shard_state(state, sh), sh
+
+
+class TestZeroReshardingRestore:
+    """The tentpole's storage half: a checkpoint written with the
+    optimizer state ZeRO-sharded over R replicas restores bitwise at
+    R' != R — Orbax reads global arrays and lands them on whatever
+    shardings the live state carries, so the capped elastic restore and
+    a deliberate fleet resize share one path."""
+
+    def test_save_at_r4_restore_at_r2_and_r1_bitwise(self, tmp_path,
+                                                     devices8):
+        from tpuic.checkpoint.manager import CheckpointManager
+        from tpuic.runtime.mesh import replica_mesh
+        from tpuic.train.state import (opt_state_bytes,
+                                       opt_state_device_bytes)
+
+        # Unsharded reference with NON-TRIVIAL moments (two real Adam
+        # updates on deterministic gradients).
+        ref_state = _tiny_state(key=0)
+        for seed in (1, 2):
+            ref_state = ref_state.apply_gradients(
+                grads=_tree_rand(ref_state.params, seed))
+        ref = jax.tree.map(np.asarray, jax.device_get(ref_state.opt_state))
+
+        # Shard it ZeRO-style over a 4-replica mesh and save.
+        mesh4 = replica_mesh(4)
+        st4, sh4 = _zero1_state(ref_state, mesh4)
+        opt_specs = {str(s.spec) for s in
+                     jax.tree_util.tree_leaves(sh4.opt_state)}
+        assert any("data" in sp for sp in opt_specs), opt_specs
+        dev0 = jax.devices()[0]
+        full = opt_state_bytes(st4)
+        b4 = opt_state_device_bytes(st4, dev0)
+        assert b4 < full, (b4, full)
+        mgr = CheckpointManager(str(tmp_path), "m", save_period=1)
+        mgr.save_latest(st4, 0, 0.0)
+        mgr.wait()
+
+        # Restore at R'=2 (still ZeRO-sharded) and R'=1 (unsharded):
+        # bitwise the reference after the implicit all-gather
+        # (device_get), and the moments land on the NEW shardings.
+        mesh2 = replica_mesh(2)
+        fresh2, _ = _zero1_state(_tiny_state(key=9), mesh2)
+        got2, _, _ = CheckpointManager(str(tmp_path), "m").restore_into(
+            fresh2)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(got2.opt_state))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert any(
+            leaf.sharding.spec != P()
+            for leaf in jax.tree_util.tree_leaves(got2.opt_state)
+            if isinstance(leaf, jax.Array)), "moments lost ZeRO sharding"
+        b2 = opt_state_device_bytes(got2, dev0)
+        assert b4 < b2 < full, (b4, b2, full)
+
+        fresh1 = _tiny_state(key=9)
+        got1, _, _ = CheckpointManager(str(tmp_path), "m").restore_into(
+            fresh1)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(got1.opt_state))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(np.asarray(jax.device_get(got1.step))) == 2
+
+    def test_corrupt_sharded_checkpoint_fails_crc(self, tmp_path):
+        """The manifest/CRC path holds for resharded restores too: silent
+        bit-rot in a sharded payload is caught, and with no intact rung
+        the restore poisons instead of resharding garbage."""
+        from tpuic.checkpoint.manager import CheckpointManager
+        from tpuic.runtime.faults import corrupt_file
+        from tpuic.runtime.mesh import replica_mesh
+        from tpuic.runtime.supervisor import NonRetryableError
+
+        st4, _ = _zero1_state(_tiny_state(key=0), replica_mesh(4))
+        mgr = CheckpointManager(str(tmp_path), "m", save_period=1)
+        mgr.save_latest(st4, 0, 0.0)
+        mgr.wait()
+        latest = os.path.join(str(tmp_path), "m", "latest")
+        victim = max((os.path.join(dp, f)
+                      for dp, _, fs in os.walk(latest) for f in fs),
+                     key=os.path.getsize)
+        corrupt_file(victim, offset=8, nbytes=16)
+        with pytest.raises(NonRetryableError):
+            CheckpointManager(str(tmp_path), "m").restore_into(
+                _tiny_state(key=9))
+
+
+# -- the rank_rejoin_flap fault point ----------------------------------------
+def test_rank_rejoin_flap_gating_and_kill(tmp_path):
+    """The flap point fires ONLY inside a fleet-capped restore, in a
+    respawned life, on the rank #PARAM names — a wrong rank, an original
+    life, or an uncapped restore all survive; the real trigger SIGKILLs
+    mid-restore (the parent observes -9, the flapping-replacement shape
+    the elastic gang books as 'flap')."""
+    script = tmp_path / "flap.py"
+    script.write_text(textwrap.dedent(f"""\
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {REPO!r})
+        from tpuic.runtime import faults
+        from tpuic.checkpoint.manager import CheckpointManager
+        mgr = CheckpointManager({str(tmp_path)!r}, "m")
+        faults.arm("rank_rejoin_flap", param=1)
+        # (a) capped + respawned but the WRONG rank: survives.
+        os.environ["TPUIC_FLEET_RANK"] = "0"
+        os.environ["TPUIC_RESTART"] = "1"
+        mgr.restore_into(None, resume_cap=5)
+        # (b) right rank but the ORIGINAL life: survives.
+        os.environ["TPUIC_FLEET_RANK"] = "1"
+        os.environ["TPUIC_RESTART"] = "0"
+        mgr.restore_into(None, resume_cap=5)
+        # (c) right rank + respawned but NO cap in force: survives.
+        os.environ["TPUIC_RESTART"] = "1"
+        os.environ.pop("TPUIC_RESUME_STEP", None)
+        mgr.restore_into(None)
+        print("GATES_OK", flush=True)
+        # (d) capped catch-up restore in a respawned life on rank 1:
+        # the flap — SIGKILL mid-restore.
+        mgr.restore_into(None, resume_cap=5)
+        print("UNREACHABLE", flush=True)
+    """))
+    proc = subprocess.run([sys.executable, str(script)], timeout=300,
+                          capture_output=True, text=True)
+    assert "GATES_OK" in proc.stdout, proc.stderr[-800:]
+    assert "UNREACHABLE" not in proc.stdout
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stderr[-800:])
+
+
+# -- elastic gang supervision ------------------------------------------------
+_CHILD_PRELUDE = textwrap.dedent("""\
+    import os, signal, sys, time
+    from tpuic.runtime.supervisor import (EXIT_PREEMPTED, EXIT_POISON,
+                                          HeartbeatWriter)
+    hb = HeartbeatWriter(os.environ["TPUIC_HEARTBEAT_FILE"],
+                         min_interval_s=0.0)
+    attempt = int(os.environ.get("TPUIC_RESTART", "0"))
+    rank = int(os.environ.get("TPUIC_FLEET_RANK", "0"))
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(EXIT_PREEMPTED))
+    def beat(step):
+        hb.last_step = step
+        hb.beat()
+""")
+
+
+def _child(tmp_path, body: str) -> list:
+    path = os.path.join(str(tmp_path), "child.py")
+    with open(path, "w") as f:
+        f.write(_CHILD_PRELUDE + textwrap.dedent(body))
+    return [sys.executable, path]
+
+
+def _elastic(tmp_path, cmd, ranks=2, **kw) -> GangSupervisor:
+    kw.setdefault("min_ranks", 1)
+    kw.setdefault("watchdog_s", 30.0)
+    kw.setdefault("startup_grace_s", 60.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 10.0)
+    kw.setdefault("backoff_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.05)
+    kw.setdefault("env", {"PYTHONPATH": REPO})
+    return GangSupervisor(cmd, os.path.join(str(tmp_path), "state"),
+                          ranks=ranks, elastic=True, **kw)
+
+
+def _ledger(sup) -> list:
+    return [json.loads(ln) for ln in open(sup.ledger_file)]
+
+
+def test_degrade_then_rejoin_without_survivor_restart(tmp_path):
+    """The tentpole semantics: rank 1 dying degrades the fleet — the
+    survivor is NEVER respawned (exactly one spawn record, pid stable
+    through the whole run), the membership file walks
+    init -> degrade -> rejoin, and the replacement's rejoin restores
+    full strength."""
+    sup = _elastic(tmp_path, _child(tmp_path, """
+        if rank == 1 and attempt == 0:
+            beat(1)
+            time.sleep(0.3)
+            os.kill(os.getpid(), signal.SIGKILL)
+        start = 2 if rank == 1 else 1
+        for s in range(start, start + 15):
+            beat(s)
+            time.sleep(0.08)
+        sys.exit(0)
+    """))
+    assert sup.run() == 0
+    assert sup.degrades == 1 and sup.rejoins == 1
+    assert sup.respawns == {0: 0, 1: 1}
+    evs = _ledger(sup)
+    spawns0 = [e for e in evs if e["event"] == "spawn" and e["rank"] == 0]
+    assert len(spawns0) == 1, "survivor was respawned"
+    # Survivor pid stable: its one spawn record's pid is the pid that
+    # exits 0 at the end (the zero-survivor-restart proof).
+    mem = [e for e in evs if e["event"] == "membership"]
+    assert [m["reason"] for m in mem] == ["init", "degrade", "rejoin"]
+    assert mem[1]["active"] == [0] and mem[2]["active"] == [0, 1]
+    final = read_membership(sup.membership_file)
+    assert final.reason == "rejoin" and final.active == [0, 1]
+    # Replacement spawned with the respawn attempt env (ENV_RESTART=1).
+    respawn_spawns = [e for e in evs
+                     if e["event"] == "spawn" and e["rank"] == 1
+                     and e["attempt"] == 1]
+    assert len(respawn_spawns) == 1
+
+
+def test_second_loss_below_min_ranks_stops_with_typed_verdict(tmp_path):
+    """Bidirectional floor: the FIRST kill (3 ranks, min 2) degrades;
+    the SECOND kill leaves 1 < min_ranks — the gang stops with the
+    typed EXIT_BELOW_MIN verdict and the last survivor still gets its
+    flush window (exit 43)."""
+    sup = _elastic(tmp_path, _child(tmp_path, """
+        beat(1)
+        if rank == 1:
+            time.sleep(0.3)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rank == 2:
+            time.sleep(1.2)
+            os.kill(os.getpid(), signal.SIGKILL)
+        while True:
+            hb.beat()
+            time.sleep(0.05)
+    """), ranks=3, min_ranks=2, max_respawns=0)
+    rc = sup.run()
+    assert rc == EXIT_BELOW_MIN
+    assert sup.degrades == 1
+    evs = _ledger(sup)
+    assert any(e["event"] == "degrade" and e["rank"] == 1 for e in evs)
+    assert any(e["event"] == "respawn_giveup" for e in evs)
+    give = [e for e in evs if e["event"] == "giveup"]
+    assert give and "below min replicas" in give[0]["reason"]
+    assert give[0]["returncode"] == EXIT_BELOW_MIN
+    # The survivor flushed 43 during the typed teardown.
+    exits0 = [e for e in evs if e["event"] == "exit" and e["rank"] == 0]
+    assert exits0 and exits0[-1]["returncode"] == EXIT_PREEMPTED
+
+
+def test_flapping_replacement_cannot_wedge_survivors(tmp_path):
+    """A replacement that dies before rejoin (the rank_rejoin_flap
+    shape) burns ONLY its own respawn budget: no extra membership
+    transitions, the survivor untouched, and the second replacement
+    rejoins normally."""
+    sup = _elastic(tmp_path, _child(tmp_path, """
+        if rank == 1 and attempt == 0:
+            beat(1)
+            time.sleep(0.3)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rank == 1 and attempt == 1:
+            os.kill(os.getpid(), signal.SIGKILL)  # flap: die pre-beat
+        start = 2 if rank == 1 else 1
+        for s in range(start, start + 15):
+            beat(s)
+            time.sleep(0.08)
+        sys.exit(0)
+    """))
+    assert sup.run() == 0
+    assert sup.degrades == 1 and sup.rejoins == 1
+    assert sup.respawns[1] == 2 and sup.respawns[0] == 0
+    evs = _ledger(sup)
+    assert any(e["event"] == "flap" and e["rank"] == 1 for e in evs)
+    mem = [e["reason"] for e in evs if e["event"] == "membership"]
+    assert mem == ["init", "degrade", "rejoin"]   # flap adds NO transition
+    assert len([e for e in evs
+                if e["event"] == "spawn" and e["rank"] == 0]) == 1
+
+
+def test_poison_still_stops_elastic_gang(tmp_path):
+    """Exit 44 from any rank stops the elastic gang without a degrade —
+    a deterministic failure replicated R times is still deterministic."""
+    sup = _elastic(tmp_path, _child(tmp_path, """
+        beat(1)
+        if rank == 1:
+            time.sleep(0.2)
+            sys.exit(EXIT_POISON)
+        while True:
+            hb.beat()
+            time.sleep(0.05)
+    """))
+    assert sup.run() == EXIT_POISON
+    assert sup.degrades == 0
+    evs = _ledger(sup)
+    assert not any(e["event"] == "degrade" for e in evs)
+
+
+def test_loss_before_any_commit_falls_back_to_full_restart(tmp_path):
+    """With ckpt_dirs wired but NO commit anywhere yet there is no step
+    to degrade from — the elastic gang answers with the restart-mode
+    fallback: everyone starts over together (membership 'restart')."""
+    for k in (0, 1):
+        os.makedirs(os.path.join(str(tmp_path), f"cp{k}", "model"),
+                    exist_ok=True)
+    sup = _elastic(tmp_path, _child(tmp_path, """
+        beat(1)
+        if rank == 1 and attempt == 0:
+            time.sleep(0.3)
+            os.kill(os.getpid(), signal.SIGKILL)
+        for s in range(2, 8):
+            beat(s)
+            time.sleep(0.05)
+        sys.exit(0)
+    """), ckpt_dirs=os.path.join(str(tmp_path), "cp{rank}", "model"))
+    assert sup.run() == 0
+    assert sup.degrades == 0 and sup.restarts >= 1
+    evs = _ledger(sup)
+    assert any(e["event"] == "membership" and e["reason"] == "restart"
+               for e in evs)
+
+
+def test_supervise_cli_wires_elastic_flags(tmp_path):
+    """python -m tpuic.supervise --gang N --elastic --min-ranks M drives
+    the elastic loop end-to-end (both ranks exit 0 -> rc 0, membership
+    file published); --elastic without --gang is a usage error."""
+    child = os.path.join(str(tmp_path), "ok.py")
+    with open(child, "w") as f:
+        f.write(_CHILD_PRELUDE + "beat(1)\nsys.exit(0)\n")
+    state = os.path.join(str(tmp_path), "state")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuic.supervise", "--state-dir", state,
+         "--gang", "2", "--elastic", "--min-ranks", "1",
+         "--poll-s", "0.05", "--", sys.executable, child],
+        cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert read_membership(os.path.join(state, "membership.json")) \
+        is not None
+    usage = subprocess.run(
+        [sys.executable, "-m", "tpuic.supervise", "--elastic", "--",
+         "true"],
+        cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=60)
+    assert usage.returncode == 2
+
+
+# -- fleet aggregator: membership timeline gate ------------------------------
+def _write_stream(path, rank, steps=3):
+    with open(path, "w") as f:
+        for s in range(steps):
+            f.write(json.dumps({"event": "step", "step": s,
+                                "total_ms": 10.0 + rank, "rank": rank,
+                                "ranks": 2}) + "\n")
+
+
+def _write_ledger(path, ever=(0, 1)):
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "membership", "version": 1,
+                            "reason": "init", "t": 1.0,
+                            "active": list(ever)}) + "\n")
+        f.write(json.dumps({"event": "membership", "version": 2,
+                            "reason": "degrade", "rank": 1, "t": 2.0,
+                            "active": [r for r in ever if r != 1],
+                            "resume_step": 4}) + "\n")
+        f.write(json.dumps({"event": "respawn", "rank": 1,
+                            "respawn": 1, "t": 3.0}) + "\n")
+        f.write(json.dumps({"event": "membership", "version": 3,
+                            "reason": "rejoin", "rank": 1, "t": 4.0,
+                            "active": list(ever)}) + "\n")
+
+
+class TestFleetMembershipGate:
+    def test_timeline_parse(self, tmp_path):
+        from tpuic.telemetry.fleet import membership_timeline
+        ledger = str(tmp_path / "ledger.jsonl")
+        _write_ledger(ledger)
+        tl = membership_timeline(ledger)
+        assert tl["ever_ranks"] == [0, 1]
+        assert [t["reason"] for t in tl["transitions"]] == \
+            ["init", "degrade", "rejoin"]
+
+    def test_elastic_coverage_gate_bidirectional(self, tmp_path, capsys):
+        from tpuic.telemetry.fleet import main as fleet_main
+        streams = tmp_path / "streams"
+        streams.mkdir()
+        _write_stream(str(streams / "events.jsonl"), 0)
+        _write_stream(str(streams / "events.rank1.jsonl"), 1)
+        ledger = str(tmp_path / "ledger.jsonl")
+        _write_ledger(ledger)
+        # Elastic run passes the timeline gate (where --require-ranks
+        # semantics would also pass here, the degraded-mid-run cases
+        # below are what it exists for).
+        assert fleet_main([str(streams), "--membership", ledger]) == 0
+        report = str(tmp_path / "report.json")
+        assert fleet_main([str(streams), "--membership", ledger,
+                           "--json", report]) == 0
+        assert json.load(open(report))["membership"]["ever_ranks"] == [0, 1]
+        # Missing member stream: loud.
+        os.remove(str(streams / "events.rank1.jsonl"))
+        assert fleet_main([str(streams), "--membership", ledger]) == 1
+        # A stream from a rank the ledger never admitted: loud.
+        _write_stream(str(streams / "events.rank1.jsonl"), 1)
+        _write_stream(str(streams / "events.rank7.jsonl"), 7)
+        assert fleet_main([str(streams), "--membership", ledger]) == 1
+        os.remove(str(streams / "events.rank7.jsonl"))
+        # Strict mode unchanged, and the two gates are exclusive.
+        assert fleet_main([str(streams), "--require-ranks", "2"]) == 0
+        assert fleet_main([str(streams), "--require-ranks", "2",
+                           "--membership", ledger]) == 2
+        # Empty ledger: nothing to gate against -> usage-style failure.
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        assert fleet_main([str(streams), "--membership", empty]) == 2
+        capsys.readouterr()
+
+
+# -- in-process mesh re-form (recompile, don't respawn) ----------------------
+@pytest.mark.slow  # two Trainer fits + a re-jit on the shrunken mesh
+def test_trainer_reforms_mesh_in_process(tmp_path, monkeypatch):
+    """A 'degrade' membership transition shrinks the LOCAL mesh without
+    a process restart: the Trainer rebuilds loaders (global batch tracks
+    the new replica count), restores the fleet-agreed step through the
+    capped ladder, re-jits, and keeps training — same pid, ZeRO
+    moments resharded onto the smaller mesh."""
+    from tpuic.config import (Config, DataConfig, MeshConfig, ModelConfig,
+                              OptimConfig, RunConfig)
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    from tpuic.train.loop import Trainer
+
+    mfile = str(tmp_path / "membership.json")
+    monkeypatch.setenv(ENV_MEMBERSHIP_FILE, mfile)
+    write_membership(mfile, Membership(1, 8, list(range(8)), None, "init"))
+    data = str(tmp_path / "data")
+    make_synthetic_imagefolder(data, classes=("a", "b"), per_class=16,
+                               size=24)
+    cfg = Config(
+        data=DataConfig(data_dir=data, resize_size=24, batch_size=2,
+                        num_workers=2, device_cache_mb=64),
+        model=ModelConfig(name="resnet18-cifar", num_classes=2,
+                          dtype="float32"),
+        optim=OptimConfig(optimizer="sgd", learning_rate=0.01,
+                          class_weights=(), milestones=(),
+                          base_batch_size=16, warmup_epochs=1),
+        run=RunConfig(epochs=2, ckpt_dir=str(tmp_path / "cp"),
+                      save_period=1, log_every_steps=1, resume=False),
+        mesh=MeshConfig(zero1=True))
+    tr = Trainer(cfg)
+    assert tr.mesh.shape["data"] == 8 and tr.membership is not None
+    tr.fit(1)
+    step = json.load(open(os.path.join(
+        str(tmp_path), "cp", "resnet18-cifar",
+        "latest.manifest.json")))["step"]
+    write_membership(mfile, Membership(2, 8, [0, 1, 2, 3], step,
+                                       "degrade", rank=5))
+    pid = os.getpid()
+    tr.start_epoch, tr.start_step = 1, 0
+    tr.fit(2)
+    assert os.getpid() == pid
+    assert tr.reforms == 1
+    assert tr.mesh.shape["data"] == 4
+    assert tr.train_loader.global_batch == 8   # 2/replica x 4 replicas
+    assert any(
+        leaf.sharding.spec != P()
+        for leaf in jax.tree_util.tree_leaves(tr.state.opt_state)
+        if isinstance(leaf, jax.Array)), "ZeRO moments lost on re-form"
+    assert int(np.asarray(jax.device_get(tr.state.step))) > step

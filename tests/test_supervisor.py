@@ -639,6 +639,7 @@ def _loop_stub(*, handle_preemption: bool, steps: int):
         train_step=lambda state, b: (state, {"loss": 0.1, "accuracy": 1.0}),
         preemption=PreemptionGuard(signals=()),
         logger=types.SimpleNamespace(write=lambda *a, **k: None),
+        membership=None,   # no elastic watcher (runtime/membership.py)
         _rollback_pending=False, _last_skip_streak=0, _quarantine_seen=0)
     return stub
 

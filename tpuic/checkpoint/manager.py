@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import signal
 import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
@@ -440,7 +441,7 @@ class CheckpointManager:
         except (OSError, ValueError, TypeError):
             return None
 
-    def _apply_resume_cap(self, rungs):
+    def _apply_resume_cap(self, rungs, cap: Optional[int] = None):
         """Fleet-consistent resume (runtime/gang.py): when the gang
         supervisor passed ``TPUIC_RESUME_STEP`` — the newest step every
         rank's committed manifest agrees on — rungs ahead of it are
@@ -448,12 +449,23 @@ class CheckpointManager:
         cap, so this rank lands exactly on the fleet-agreed step instead
         of resuming ahead of peers that never committed it (a survivor's
         mid-teardown flush is deliberately newer than a crashed peer's
-        last commit — the precise rung this filter exists to skip)."""
-        from tpuic.runtime.supervisor import ENV_RESUME_STEP
-        raw = os.environ.get(ENV_RESUME_STEP, "")
-        if not raw or not rungs:
-            return rungs
-        allowed = int(raw)  # a malformed supervisor env must fail LOUD
+        last commit — the precise rung this filter exists to skip).
+
+        ``cap``: an explicit fleet-agreed step wins over the env — the
+        elastic degrade path (docs/parallelism.md): a SURVIVOR re-forms
+        in-process from the membership record's step, no respawn and
+        therefore no fresh env to carry it."""
+        if cap is None:
+            from tpuic.runtime.supervisor import ENV_RESUME_STEP
+            raw = os.environ.get(ENV_RESUME_STEP, "")
+            if not raw or not rungs:
+                return rungs
+            # A malformed supervisor env must fail LOUD.
+            allowed = int(raw)
+        else:
+            if not rungs:
+                return rungs
+            allowed = int(cap)
         steps = {r: self._manifest_step(r) for r in rungs}
         kept = [r for r in rungs
                 if steps[r] is None or steps[r] <= allowed]
@@ -513,7 +525,8 @@ class CheckpointManager:
                                f"(expected {expected[rel]}, got {live[rel]})")
         return False, "manifest mismatch"  # pragma: no cover — unreachable
 
-    def restore_into(self, state, track: Optional[str] = None):
+    def restore_into(self, state, track: Optional[str] = None,
+                     resume_cap: Optional[int] = None):
         """Verified restore of ``state`` through the integrity ladder.
 
         ``track=None`` starts at the newest of latest/best and falls back
@@ -525,7 +538,17 @@ class CheckpointManager:
         reference's probe at train.py:136. Raises RuntimeError when
         checkpoints exist but EVERY rung is corrupt — training silently
         restarting from scratch would be worse than stopping.
-        ``last_restore_rung`` records the rung actually used."""
+        ``last_restore_rung`` records the rung actually used.
+
+        ``resume_cap``: explicit fleet-agreed step cap (the elastic
+        degrade path — see ``_apply_resume_cap``); overrides any
+        ``TPUIC_RESUME_STEP`` env. This capped restore is also where a
+        resharding restore lands: a checkpoint written at R replicas
+        (ZeRO-sharded optimizer state over the ``data`` axis) restores
+        into whatever shardings the LIVE state carries — Orbax reads
+        global arrays and lays them onto the R′-replica mesh's
+        shardings, so R → R′ needs no conversion step
+        (tests/test_elastic.py pins R=4 → R′∈{2,1} bitwise)."""
         self.wait()  # don't read a track an async save is still writing
         # (n_loaded, n_total) of the last restore's param-leaf merge; None
         # for the sharded fast path (exact structure = full load). Lets
@@ -556,7 +579,20 @@ class CheckpointManager:
             rungs = [track, track + ".prev"]
         rungs = [t for t in rungs
                  if os.path.isdir(os.path.join(self.root, t))]
-        rungs = self._apply_resume_cap(rungs)
+        from tpuic.runtime.supervisor import ENV_RESUME_STEP
+        capped = (resume_cap is not None
+                  or bool(os.environ.get(ENV_RESUME_STEP, "")))
+        rungs = self._apply_resume_cap(rungs, cap=resume_cap)
+        if capped and _faults.fire("rank_rejoin_flap"):
+            # Flapping-replacement fault (docs/robustness.md): die INSIDE
+            # the catch-up (fleet-capped) restore — but only on the rank
+            # #PARAM names and only in a respawned life, so the original
+            # ranks' spawn-time restores never trip it.
+            target = _faults.param("rank_rejoin_flap")
+            rank = int(os.environ.get("TPUIC_FLEET_RANK", "0") or 0)
+            respawned = int(os.environ.get("TPUIC_RESTART", "0") or 0) > 0
+            if respawned and rank == int(target or 0):
+                os.kill(os.getpid(), signal.SIGKILL)
         if not rungs:
             return state, 0, 0.0
         failures = []

@@ -210,6 +210,22 @@ class OptimConfig:
     # LARS settings for the large-batch config (BASELINE.md config 5).
     lars_momentum: float = 0.9
     lars_trust_coefficient: float = 0.001
+    # LAMB (arXiv:1904.00962) moments — the Adam-flavored layer-wise
+    # trust-ratio optimizer for large-batch attention models
+    # (optimizer='lamb'; weight_decay rides the shared knob).
+    lamb_b1: float = 0.9
+    lamb_b2: float = 0.999
+    lamb_eps: float = 1e-6
+    # Goyal linear-scaling rule (arXiv:1706.02677; every 15-minute-
+    # ImageNet recipe's ingredient): when > 0, the peak LR becomes
+    # learning_rate * global_batch / base_batch_size, reached by a
+    # LINEAR warmup from the unscaled learning_rate over warmup_epochs
+    # (train/schedule.py batch_scaled_warmup_schedule). The global batch
+    # is per-device batch x data-parallel extent, so the SAME config
+    # stays correctly tuned as the fleet grows — or elastically shrinks
+    # (the re-formed mesh rebuilds the schedule at its new extent).
+    # 0 (default) disables scaling entirely.
+    base_batch_size: int = 0
     warmup_epochs: int = 0
     grad_clip_norm: float = 0.0
     # Accumulate gradients over K steps before applying one optimizer

@@ -122,3 +122,26 @@ def runtime_info() -> RuntimeInfo:
         global_device_count=jax.device_count(),
         platform=jax.devices()[0].platform,
     )
+
+
+def data_parallel_replicas() -> int:
+    """The CURRENT data-parallel extent of this process's fleet.
+
+    Elastic runs (runtime/gang.py elastic mode) publish the live
+    membership via ``TPUIC_MEMBERSHIP_FILE`` — its ``active`` count is
+    the R the fleet is actually running at, which may be below the
+    configured world mid-degrade. Without a membership file, the
+    launcher's ``TPUIC_FLEET_RANKS`` override wins (independent-rank CPU
+    fleets), then the live ``jax.process_count()``. Poll-cheap (one
+    stat + read only when the file moved is the watcher's job; this is
+    the one-shot read for wiring/telemetry, not the hot loop)."""
+    from tpuic.runtime.membership import ENV_MEMBERSHIP_FILE, read_membership
+    path = os.environ.get(ENV_MEMBERSHIP_FILE, "")
+    if path:
+        m = read_membership(path)
+        if m is not None:
+            return max(1, m.replicas)
+    ranks = os.environ.get("TPUIC_FLEET_RANKS")
+    if ranks:
+        return max(1, int(ranks))
+    return jax.process_count()

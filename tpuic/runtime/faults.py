@@ -88,6 +88,21 @@ Registered points (site → meaning of ``step``):
                       SLO-burn auto-rollback trigger); rolling back to
                       the boot weights stands the fault down, so the
                       post-rollback fleet is provably healthy again.
+- ``rank_rejoin_flap`` — fleet-capped checkpoint restore
+                      (checkpoint/manager.py ``restore_into``): SIGKILL
+                      this process while it is INSIDE its catch-up
+                      restore (a resume cap is in force — the elastic
+                      gang's degrade/rejoin path, runtime/gang.py), but
+                      only on the rank ``param`` names (default 0, from
+                      ``TPUIC_FLEET_RANK``) and only in a respawned
+                      life (``TPUIC_RESTART`` > 0) — so the original
+                      ranks' spawn-time restores never trip it. The
+                      flapping-replacement trigger: a rejoining rank
+                      that dies mid-catch-up must burn its own respawn
+                      budget without wedging or desyncing the
+                      survivors (scripts/elastic_soak.py proves the
+                      second replacement rejoins and the final metrics
+                      stay bitwise-equal to the undisturbed baseline).
 - ``replica_wedge`` — serve socket transport: stop servicing the socket
                       at the Nth accepted request (sleep ``param``
                       seconds; effectively forever without a payload) —
@@ -135,8 +150,8 @@ __all__ = ["InjectedFault", "FaultPlan", "plan", "arm", "disarm", "reset",
 REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
     "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
-    "rank_hang", "replica_crash", "replica_wedge", "swap_corrupt",
-    "canary_degrade",
+    "rank_hang", "rank_rejoin_flap", "replica_crash", "replica_wedge",
+    "swap_corrupt", "canary_degrade",
 })
 
 

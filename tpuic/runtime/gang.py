@@ -55,12 +55,35 @@ whole fleet as one failure domain:
   source. ``{rank}`` in the child command is substituted per rank
   (per-rank checkpoint dirs, log paths).
 
+- **Elastic membership** (``elastic=True``; docs/parallelism.md,
+  "Elastic data parallelism"). The coordinated restart above answers a
+  lost rank by tearing every survivor down; the elastic mode answers it
+  with a *degrade*: the lost rank is removed from the published
+  membership view (``membership.json``, runtime/membership.py), the
+  fleet-agreed resume step rides the same record, and the survivors —
+  polling the file at step boundaries — re-form an (R−1)-replica view
+  from that step **without their processes restarting** (they restore
+  through the capped integrity ladder and recompile; same pids). A
+  replacement rank is respawned with the resume cap in its env and
+  *rejoins* at the next fleet-agreed boundary (its first post-restore
+  step observed), bumping the membership back to R. A loss that would
+  take the fleet below ``min_ranks`` stops the gang with the typed
+  ``EXIT_BELOW_MIN`` verdict (survivors still get their flush window);
+  a flapping replacement (dies during its catch-up restore —
+  ``rank_rejoin_flap``) burns its per-rank respawn budget without ever
+  touching the survivors' membership view.
+
 Like the single supervisor, this module imports only the stdlib: the
 parent must never initialize jax, and must outlive any backend wedge a
 rank hits. The end-to-end proof is ``scripts/gang_soak.py`` (CI-gated):
 a seeded single-rank crash triggers exactly one coordinated restart with
 the survivor's 43 flush and a fleet-agreed resume step, final metrics
 bitwise-equal to an undisturbed baseline; a seeded poison stops the gang.
+The elastic mode's proof is ``scripts/elastic_soak.py``: a rank killed
+mid-epoch degrades the fleet (zero survivor restarts, pids pinned), the
+replacement rejoins, and the final metrics are bitwise-equal to an
+undisturbed baseline; a second kill below ``min_ranks`` stops the gang
+with the typed verdict.
 """
 
 from __future__ import annotations
@@ -74,12 +97,14 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from tpuic.runtime.membership import (ENV_MEMBERSHIP_FILE, Membership,
+                                      write_membership)
 from tpuic.runtime.supervisor import (DONE, ENV_DOWN_SINCE,
                                       ENV_HEARTBEAT_INTERVAL, ENV_RESTART,
-                                      ENV_RESUME_STEP, EXIT_CRASH_LOOP,
-                                      EXIT_POISON, EXIT_PREEMPTED, POISON,
-                                      PREEMPTED, RETRYABLE, _Child,
-                                      classify_exit)
+                                      ENV_RESUME_STEP, EXIT_BELOW_MIN,
+                                      EXIT_CRASH_LOOP, EXIT_POISON,
+                                      EXIT_PREEMPTED, POISON, PREEMPTED,
+                                      RETRYABLE, _Child, classify_exit)
 
 # The rank-identity env the launcher half of telemetry/fleet.py reads
 # (kept as string literals there too — both modules are import-light on
@@ -173,7 +198,17 @@ class GangSupervisor:
     ``*.manifest.json`` sidecars) — a ``{rank}`` template string or an
     explicit per-rank sequence; enables the fleet-agreed resume step.
     ``coordinator``: when set, each rank additionally gets the full
-    jax.distributed env rendezvous trio."""
+    jax.distributed env rendezvous trio.
+
+    ``elastic=True`` switches rank loss from coordinated-restart to the
+    degrade/rejoin protocol (module docstring): ``min_ranks`` is the
+    floor below which the gang stops with ``EXIT_BELOW_MIN``;
+    ``max_respawns`` bounds how many times ONE rank's replacement may be
+    respawned (default: ``max_restarts``) before that rank is declared
+    lost and the fleet continues permanently degraded. In elastic mode
+    the per-spawn ``chaos`` spec indexes by the rank's respawn count
+    (original spawn = spec 0, first replacement = spec 1, …), mirroring
+    the per-attempt semantics of the restart mode."""
 
     def __init__(self, cmd: Sequence[str], state_dir: str, *, ranks: int,
                  watchdog_s: float = 300.0, startup_grace_s: float = 1800.0,
@@ -184,6 +219,8 @@ class GangSupervisor:
                  chaos: Optional[Sequence[str]] = None,
                  ckpt_dirs: Union[str, Sequence[str], None] = None,
                  coordinator: str = "",
+                 elastic: bool = False, min_ranks: int = 1,
+                 max_respawns: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
                  log: Optional[Callable[[str], None]] = None) -> None:
         self.cmd = list(cmd)
@@ -217,6 +254,19 @@ class GangSupervisor:
         else:
             self.ckpt_dirs = None
         self.coordinator = coordinator
+        self.elastic = bool(elastic)
+        self.min_ranks = int(min_ranks)
+        if self.elastic and not 1 <= self.min_ranks <= self.ranks:
+            raise ValueError(f"min_ranks must be in [1, {self.ranks}] "
+                             f"(got {min_ranks})")
+        self.max_respawns = (self.max_restarts if max_respawns is None
+                             else int(max_respawns))
+        self.membership_file = os.path.join(self.state_dir,
+                                            "membership.json")
+        self._membership_version = 0
+        self.respawns: Dict[int, int] = {k: 0 for k in range(self.ranks)}
+        self.degrades = 0
+        self.rejoins = 0
         self.extra_env = dict(env or {})
         self._log = log or (lambda msg: print(f"[gang] {msg}",
                                               file=sys.stderr, flush=True))
@@ -245,7 +295,8 @@ class GangSupervisor:
         # external signal harmless — the single supervisor's flake fix).
         self._shutdown = True
         for c in self._children:
-            c.term()
+            if c is not None:   # elastic spawn loop may be mid-fill
+                c.term()
 
     def _spawn_env(self, attempt: int, rank: int, down_since: float,
                    resume_step: Optional[int]) -> Dict[str, str]:
@@ -260,6 +311,10 @@ class GangSupervisor:
         # jax.distributed collectives themselves.
         env[ENV_FLEET_RANK] = str(rank)
         env[ENV_FLEET_RANKS] = str(self.ranks)
+        if self.elastic:
+            # The Trainer watches this file at step boundaries
+            # (runtime/membership.py) and re-forms on degrade events.
+            env[ENV_MEMBERSHIP_FILE] = self.membership_file
         if self.coordinator:
             env["TPUIC_COORDINATOR_ADDRESS"] = self.coordinator
             env["TPUIC_NUM_PROCESSES"] = str(self.ranks)
@@ -425,6 +480,353 @@ class GangSupervisor:
         self._children = []
         return res
 
+    # -- elastic membership ---------------------------------------------
+    def _publish_membership(self, reason: str, active: Sequence[int],
+                            resume_step: Optional[int],
+                            rank: Optional[int] = None) -> Membership:
+        """Atomically publish a new fleet view (version strictly
+        increasing) and mirror it into the ledger — the one channel the
+        ranks' step-boundary watchers read (runtime/membership.py)."""
+        self._membership_version += 1
+        m = Membership(version=self._membership_version, world=self.ranks,
+                       active=sorted(int(k) for k in active),
+                       resume_step=resume_step, reason=reason, rank=rank,
+                       t=round(time.time(), 3))
+        write_membership(self.membership_file, m)
+        self._ledger("membership", version=m.version, reason=reason,
+                     active=m.active, resume_step=resume_step, rank=rank)
+        return m
+
+    def _fleet_step_for(self, ranks: Sequence[int]) -> Optional[int]:
+        """fleet_resume_step over the named ranks' checkpoint dirs (None
+        without ``ckpt_dirs`` — stdlib test gangs have no checkpoints)."""
+        if not self.ckpt_dirs:
+            return None
+        return fleet_resume_step([self.ckpt_dirs[k] for k in ranks])
+
+    def _spawn_rank(self, k: int, respawn: int, down_since: float,
+                    resume_step: Optional[int]) -> _Child:
+        """(Re)spawn rank ``k``; ``respawn`` doubles as the ENV_RESTART
+        attempt index and the per-spawn chaos-spec index, so a
+        replacement life is distinguishable from the original (the
+        ``rank_rejoin_flap`` fault point and the step-accounting checks
+        both key on it)."""
+        child = _Child(
+            self._rank_cmd(k),
+            heartbeat_file=rank_path(
+                os.path.join(self.state_dir, "heartbeat.json"), k),
+            stack_dump=rank_path(
+                os.path.join(self.state_dir, f"stackdump-{respawn}.txt"),
+                k),
+            flight_dump=rank_path(
+                os.path.join(self.state_dir,
+                             f"flightdump-{respawn}.jsonl"), k),
+            label=f"rank {k}")
+        child.spawn(self._spawn_env(respawn, k, down_since, resume_step))
+        self._ledger("spawn", attempt=respawn, rank=k, pid=child.pid,
+                     restart=respawn > 0,
+                     faults=(self.chaos[respawn]
+                             if self.chaos and respawn < len(self.chaos)
+                             else ""))
+        self._children[k] = child
+        return child
+
+    def _book_rank_exit(self, k: int, c: _Child, rc: int) -> None:
+        """Per-rank ledger + step-accounting bookkeeping for one life
+        (the elastic twin of ``_book_progress``: there is no gang
+        attempt to fold into, but first>best+1 violations and per-rank
+        best steps are checked identically)."""
+        c.observe()
+        if (c.first_step is not None and self.best_steps[k] is not None
+                and c.first_step > self.best_steps[k] + 1):
+            self.violations += 1
+            self._log(f"LEDGER VIOLATION: rank {k} first step "
+                      f"{c.first_step} skips past its best previous "
+                      f"step {self.best_steps[k]}")
+            self._ledger("violation", rank=k, first_step=c.first_step,
+                         best_step=self.best_steps[k])
+        if c.last_step is not None and (self.best_steps[k] is None
+                                        or c.last_step
+                                        > self.best_steps[k]):
+            self.best_steps[k] = c.last_step
+        self._ledger("exit", rank=k, returncode=rc, hung=c.hung,
+                     respawn=self.respawns[k], first_step=c.first_step,
+                     last_step=c.last_step,
+                     outcome=classify_exit(rc, self._shutdown))
+
+    def _elastic_shutdown(self) -> int:
+        """Shared eviction / operator stop: mirror the restart-mode
+        semantics — flush everyone, propagate 43/0, report poison."""
+        self._teardown("shutdown", None)
+        codes = [c.finalize() for c in self._children]
+        bad = [rc for rc in codes if classify_exit(rc, True) == POISON]
+        if bad:
+            code = bad[0]
+            if code < 0:
+                code = 128 - code
+            return self._give_up(
+                f"rank exit code(s) {codes} during supervisor shutdown",
+                code)
+        code = EXIT_PREEMPTED if EXIT_PREEMPTED in codes else 0
+        self._log(f"elastic gang shut down (codes {codes}); exit {code}")
+        self._ledger("done", restarts=self.restarts,
+                     degrades=self.degrades, rejoins=self.rejoins,
+                     best_fleet_step=self.best_fleet_step,
+                     returncode=code)
+        return code
+
+    def _run_elastic(self) -> int:
+        """The degrade/rejoin supervision loop (module docstring).
+
+        Rank statuses: ``up`` (a mesh member), ``joining`` (a respawned
+        replacement catching up — NOT yet in the published membership),
+        ``down`` (dead, a respawn scheduled), ``lost`` (respawn budget
+        exhausted — the fleet continues permanently degraded), ``done``
+        (exited 0). The gang completes when every rank is done or lost;
+        poison from ANY rank still stops it, and an active-member count
+        below ``min_ranks`` stops it with the typed ``EXIT_BELOW_MIN``
+        verdict."""
+        down_since = time.time()
+        resume_step: Optional[int] = None
+        status = {k: "up" for k in range(self.ranks)}
+        due: Dict[int, float] = {}     # rank -> respawn due (monotonic)
+        down_at: Dict[int, float] = {}  # rank -> wall time of its death
+        self._children = [None] * self.ranks  # type: ignore[list-item]
+        self._publish_membership("init", list(range(self.ranks)), None)
+        for k in range(self.ranks):
+            self._spawn_rank(k, 0, down_since, None)
+
+        def members() -> List[int]:
+            """The mesh view: ranks in good standing — still training
+            ("up") or having COMPLETED their run ("done"). A completed
+            rank left cleanly, not by failure, so it stays in the
+            published membership."""
+            return [k for k in range(self.ranks)
+                    if status[k] in ("up", "done")]
+
+        def lose_member(k: int, why: str) -> Optional[int]:
+            """A mesh member died: degrade (membership bump + scheduled
+            replacement) or, below ``min_ranks``, stop the gang with the
+            typed verdict. Returns an exit code to propagate, or None
+            to keep supervising."""
+            nonlocal resume_step
+            survivors = [r for r in members() if r != k]
+            # The caller booked this exit already — drop the rank out of
+            # "up" FIRST so the teardown/restart paths below never book
+            # (or TERM) the same death twice.
+            status[k] = "down"
+            down_at.setdefault(k, time.time())
+            if len(survivors) < self.min_ranks:
+                self._log(f"rank {k} lost ({why}) and "
+                          f"{len(survivors)} survivor(s) < min_ranks "
+                          f"{self.min_ranks}: stopping the gang "
+                          f"(typed verdict, exit {EXIT_BELOW_MIN})")
+                self._teardown(f"below min_ranks after {why}", k)
+                for r, c in enumerate(self._children):
+                    if status[r] in ("up", "joining"):
+                        self._book_rank_exit(r, c, c.finalize())
+                        status[r] = "down"
+                return self._give_up(
+                    f"fleet below min replicas: rank {k} lost ({why}), "
+                    f"{len(survivors)} survivor(s) < min_ranks="
+                    f"{self.min_ranks}", EXIT_BELOW_MIN)
+            step = self._fleet_step_for(survivors + [k])
+            if step is None and self.ckpt_dirs:
+                # No commit anywhere yet (the run died before its first
+                # checkpoint): there is no step to degrade FROM, so fall
+                # back to the restart-mode answer — everyone starts over
+                # together, budgeted like any retryable gang failure.
+                return restart_all(f"{why} before any fleet commit")
+            resume_step = step
+            self.degrades += 1
+            self._publish_membership("degrade", survivors, step, rank=k)
+            self._ledger("degrade", rank=k, why=why, survivors=survivors,
+                         resume_step=step)
+            self._log(f"rank {k} lost ({why}): fleet degrades to "
+                      f"{len(survivors)}/{self.ranks} from fleet-agreed "
+                      f"step {step} — survivors re-form in place (no "
+                      "process restart); replacement scheduled")
+            return schedule_respawn(k)
+
+        def schedule_respawn(k: int) -> Optional[int]:
+            if self.respawns[k] >= self.max_respawns:
+                status[k] = "lost"
+                due.pop(k, None)
+                self._ledger("respawn_giveup", rank=k,
+                             respawns=self.respawns[k])
+                self._log(f"rank {k}: respawn budget exhausted "
+                          f"({self.respawns[k]}/{self.max_respawns}) — "
+                          "continuing permanently degraded")
+                return None
+            delay = min(self.backoff_max_s,
+                        self.backoff_s * (2.0 ** self.respawns[k]))
+            due[k] = time.monotonic() + delay
+            return None
+
+        def restart_all(why: str) -> Optional[int]:
+            nonlocal down_since, resume_step
+            self.crash_restarts += 1
+            self.restarts += 1
+            if self.crash_restarts > self.max_restarts:
+                self._teardown(why, None)
+                for r, c in enumerate(self._children):
+                    if status[r] in ("up", "joining"):
+                        self._book_rank_exit(r, c, c.finalize())
+                return self._give_up(
+                    f"restart budget exhausted ({self.max_restarts}) "
+                    f"after {why}", EXIT_CRASH_LOOP)
+            self._teardown(why, None)
+            for r, c in enumerate(self._children):
+                if status[r] in ("up", "joining"):
+                    self._book_rank_exit(r, c, c.finalize())
+            down_since = time.time()
+            down_at.clear()
+            resume_step = self._fleet_step_for(list(range(self.ranks)))
+            self._publish_membership("restart", list(range(self.ranks)),
+                                     resume_step)
+            self._log(f"elastic full restart #{self.restarts} ({why}); "
+                      f"fleet resume step {resume_step}")
+            for r in range(self.ranks):
+                self.respawns[r] += 1
+                status[r] = "up"
+                due.pop(r, None)
+                self._spawn_rank(r, self.respawns[r], down_since,
+                                 resume_step)
+            return None
+
+        while True:
+            time.sleep(self.poll_s)
+            now = time.monotonic()
+            for k, c in enumerate(self._children):
+                if status[k] in ("up", "joining") and c.alive():
+                    c.observe(now)
+            if self._shutdown:
+                return self._elastic_shutdown()
+            # Exits.
+            for k, c in enumerate(self._children):
+                if status[k] not in ("up", "joining"):
+                    continue
+                rc = c.poll()
+                if rc is None:
+                    continue
+                hung = c.hung
+                joining = status[k] == "joining"
+                self._book_rank_exit(k, c, c.finalize())
+                outcome = classify_exit(rc)
+                if outcome == POISON:
+                    self._teardown("poison", k)
+                    for r, cc in enumerate(self._children):
+                        if r != k and status[r] in ("up", "joining"):
+                            self._book_rank_exit(r, cc, cc.finalize())
+                            status[r] = "down"
+                    return self._give_up(
+                        f"rank {k} exited poison ({rc}): respawning "
+                        "cannot help", EXIT_POISON)
+                if outcome == DONE and not hung:
+                    status[k] = "done"
+                    continue
+                # Retryable crash, signal death, watchdog kill, or a
+                # lone flush (43 outside a fleet eviction = that rank
+                # alone was told to stop — it still needs replacing).
+                if joining:
+                    # The replacement died CATCHING UP (the
+                    # rank_rejoin_flap shape): survivors' membership
+                    # view never included it, so nothing re-forms —
+                    # just burn its respawn budget and try again.
+                    self._ledger("flap", rank=k, returncode=rc,
+                                 respawns=self.respawns[k])
+                    self._log(f"rank {k} replacement died before "
+                              f"rejoin (code {rc}) — flapping; "
+                              "survivors untouched")
+                    status[k] = "down"
+                    # The downtime clock keeps running from the ORIGINAL
+                    # death (down_at already holds it) — a flap extends
+                    # the outage, it doesn't restart the meter.
+                    code = schedule_respawn(k)
+                else:
+                    code = lose_member(
+                        k, "hang" if hung else f"exit {rc}")
+                if code is not None:
+                    return code
+            # Hangs: escalate (rank-attributed), then the exit scan
+            # above books the death on the next poll.
+            for k, c in enumerate(self._children):
+                if status[k] not in ("up", "joining") or not c.alive():
+                    continue
+                window = c.window_s(self.watchdog_s, self.startup_grace_s)
+                stale = c.stale_s(now)
+                if stale > window:
+                    self._log(f"HANG on rank {k} — no heartbeat for "
+                              f"{stale:.1f}s (window {window:.0f}s, last "
+                              f"step {c.last_step}); SIGQUIT stack dump, "
+                              "then SIGTERM, then SIGKILL")
+                    self._ledger("hang", rank=k, stale_s=round(stale, 1),
+                                 last_step=c.last_step,
+                                 stack_dump=c.stack_dump,
+                                 flight_dump=c.flight_dump)
+                    c.escalate(self.quit_wait_s, self.grace_s)
+            # Due respawns.
+            for k in [r for r, t in due.items() if now >= t]:
+                del due[k]
+                self.respawns[k] += 1
+                self.restarts += 1
+                status[k] = "joining"
+                self._ledger("respawn", rank=k, respawn=self.respawns[k],
+                             resume_step=resume_step)
+                # ENV_DOWN_SINCE carries the DEATH time, not the spawn
+                # time, so the replacement's 'restart' event books the
+                # full detection+backoff outage as downtime
+                # (docs/observability.md: "death -> restore").
+                self._spawn_rank(k, self.respawns[k],
+                                 down_at.get(k, time.time()),
+                                 resume_step)
+            # Rejoins: a replacement that took its first post-restore
+            # step is at the fleet boundary — fold it back in.
+            for k, c in enumerate(self._children):
+                if status[k] != "joining" or c.last_step is None:
+                    continue
+                status[k] = "up"
+                self.rejoins += 1
+                down_at.pop(k, None)
+                # The rejoin record carries the standing resume cap, NOT
+                # None: the membership file holds only the latest view,
+                # so a survivor stalled through the whole degrade->rejoin
+                # window (a long val pass) sees ONLY this record — with
+                # the cap aboard (plus the watcher's skipped-version
+                # count) it can still restore the fleet-agreed step
+                # instead of silently training ahead of the re-formed
+                # fleet.
+                self._publish_membership("rejoin", members(), resume_step,
+                                         rank=k)
+                self._ledger("rejoin", rank=k, step=c.last_step,
+                             respawn=self.respawns[k])
+                self._log(f"rank {k} rejoined the fleet at step "
+                          f"{c.last_step} (respawn {self.respawns[k]}) "
+                          f"— membership back to {len(members())}/"
+                          f"{self.ranks}")
+            # Fleet-min progress bookkeeping (informational in elastic
+            # mode — the crash-loop currency is the per-rank budget).
+            lasts = [c.last_step for k, c in enumerate(self._children)
+                     if status[k] in ("up", "done")]
+            if lasts and all(s is not None for s in lasts):
+                fleet = min(lasts)
+                if (self.best_fleet_step is None
+                        or fleet > self.best_fleet_step):
+                    self.best_fleet_step = fleet
+            if all(s in ("done", "lost") for s in status.values()):
+                code = (0 if any(s == "done" for s in status.values())
+                        else EXIT_CRASH_LOOP)
+                self._log(f"elastic gang finished (statuses {status}); "
+                          f"{self.degrades} degrade(s), "
+                          f"{self.rejoins} rejoin(s), best fleet step "
+                          f"{self.best_fleet_step}")
+                self._ledger("done", restarts=self.restarts,
+                             degrades=self.degrades,
+                             rejoins=self.rejoins,
+                             best_fleet_step=self.best_fleet_step,
+                             returncode=code)
+                return code
+
     # -- the supervision loop -------------------------------------------
     def run(self) -> int:
         installed = {}
@@ -434,7 +836,7 @@ class GangSupervisor:
             except (ValueError, OSError):  # non-main thread (tests)
                 pass
         try:
-            return self._run()
+            return self._run_elastic() if self.elastic else self._run()
         finally:
             for sig, prev in installed.items():
                 try:

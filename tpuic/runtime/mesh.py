@@ -10,6 +10,7 @@ physical ICI torus so the heavy ``data``-axis collectives ride neighbor links.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Sequence
 
 import jax
@@ -54,6 +55,35 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
             pass  # older make_mesh signature without axis_types/devices
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, tuple(cfg.axis_names))
+
+
+def replica_mesh(replicas: int, cfg: Optional[MeshConfig] = None,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """An R-replica data-parallel mesh over the FIRST ``replicas`` replica
+    slots — the elastic re-form constructor (docs/parallelism.md,
+    "Elastic data parallelism").
+
+    ``make_mesh`` demands that the axes cover every device; a fleet that
+    just lost a replica needs the opposite: the same (seq, model) inner
+    shape laid over ``replicas`` of the surviving replica slots, with the
+    rest of the host's devices idle. Each replica slot is ``seq*model``
+    consecutive devices, so shrinking R keeps every surviving replica's
+    inner axes on the same devices (no param migration inside a
+    replica — only the data axis narrows, which is exactly what the
+    ZeRO-sharded optimizer state reshards over on the capped restore)."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    replicas = int(replicas)
+    if replicas < 1:
+        raise ValueError(f"replica_mesh needs >= 1 replica (got {replicas})")
+    per_replica = max(1, cfg.seq) * max(1, cfg.model)
+    need = replicas * per_replica
+    if need > len(devices):
+        raise ValueError(
+            f"replica_mesh: {replicas} replicas x {per_replica} devices "
+            f"each = {need} devices, but only {len(devices)} available")
+    return make_mesh(dataclasses.replace(cfg, data=replicas),
+                     devices=devices[:need])
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -83,6 +83,7 @@ EXIT_OK = 0
 EXIT_PREEMPTED = 43   # clean preemption flush: state is on disk, resume me
 EXIT_POISON = 44      # non-retryable: restarting cannot help
 EXIT_CRASH_LOOP = 45  # supervisor verdict: retries exhausted / no progress
+EXIT_BELOW_MIN = 46   # elastic gang verdict: fleet fell below min replicas
 
 # Environment protocol between supervisor and child.
 ENV_HEARTBEAT_FILE = "TPUIC_HEARTBEAT_FILE"

@@ -34,6 +34,13 @@ the fleet. ``{rank}`` in the child command is substituted per rank::
         --gang-ckpt /work/cp{rank}/resnet50 -- \\
         python train.py --datadir /data --model resnet50 \\
             --ckpt-dir /work/cp{rank}
+
+``--gang N --elastic`` switches rank loss from coordinated-restart to
+degrade/rejoin (docs/parallelism.md, "Elastic data parallelism"):
+survivors re-form from the fleet-agreed step IN PLACE (membership
+published via ``TPUIC_MEMBERSHIP_FILE``, no survivor process restart),
+a replacement rank rejoins at the next fleet boundary, and only a loss
+below ``--min-ranks`` stops the gang (typed exit 46).
 """
 
 from __future__ import annotations
@@ -99,6 +106,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "Enables restart-consistent resume: the newest "
                         "step every rank's committed manifest agrees on "
                         "is passed down via TPUIC_RESUME_STEP")
+    p.add_argument("--elastic", action="store_true",
+                   help="with --gang: treat rank loss as a DEGRADE event "
+                        "instead of a coordinated restart (runtime/gang.py "
+                        "elastic mode, docs/parallelism.md): survivors "
+                        "re-form from the fleet-agreed step in place (no "
+                        "process restart, membership published via "
+                        "TPUIC_MEMBERSHIP_FILE), a replacement rank "
+                        "rejoins at the next fleet boundary")
+    p.add_argument("--min-ranks", type=int, default=1, metavar="M",
+                   help="elastic floor: a loss that would leave fewer "
+                        "than M active ranks stops the gang with the "
+                        "typed below-min verdict (exit 46)")
+    p.add_argument("--max-respawns", type=int, default=None, metavar="N",
+                   help="per-rank replacement respawn budget in elastic "
+                        "mode (default: --max-restarts); past it the rank "
+                        "is declared lost and the fleet continues "
+                        "permanently degraded")
     p.add_argument("--coordinator", default="", metavar="HOST:PORT",
                    help="TPUIC_COORDINATOR_ADDRESS for the ranks (also "
                         "sets TPUIC_PROCESS_ID/TPUIC_NUM_PROCESSES — the "
@@ -135,7 +159,12 @@ def main(argv=None) -> int:
         return GangSupervisor(
             cmd, args.state_dir, ranks=args.gang,
             ckpt_dirs=args.gang_ckpt or None,
-            coordinator=args.coordinator, **common).run()
+            coordinator=args.coordinator, elastic=args.elastic,
+            min_ranks=args.min_ranks, max_respawns=args.max_respawns,
+            **common).run()
+    if args.elastic:
+        print("supervise: --elastic requires --gang N", file=sys.stderr)
+        return 2
     return Supervisor(cmd, args.state_dir, **common).run()
 
 

@@ -56,7 +56,13 @@ EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                # executable reuse vs prewarm), one 'rollout' event per
                # canary-rollout transition (start/stage/rollback/
                # promote/refused — tpuic/serve/rollout.py).
-               "swap", "rollout")
+               "swap", "rollout",
+               # Elastic data parallelism (runtime/gang.py elastic mode,
+               # docs/parallelism.md): one 'reform' event per membership
+               # transition the trainer acted on — a degrade restores
+               # the fleet-agreed step in place (no process restart), a
+               # rejoin is noted without a restore.
+               "reform")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -144,6 +144,40 @@ def load_streams(paths: Sequence[str]) -> Dict[int, List[dict]]:
     return streams
 
 
+# -- elastic membership timelines --------------------------------------------
+def membership_timeline(ledger_path: str) -> dict:
+    """Parse an elastic gang ledger (runtime/gang.py ``ledger.jsonl``)
+    into the fleet's membership timeline: every ``membership`` record
+    (init/degrade/rejoin/restart transitions with their active sets)
+    plus the union of every rank that was EVER a member or spawned.
+
+    The aggregator's coverage gate uses this for elastic runs: under
+    ``--require-ranks N`` a degraded fleet looks like a dead rank's
+    missing stream and a replacement looks like an unexpected one —
+    both hard failures — but against the timeline a shrink/grow is
+    LEGAL as long as the streams cover exactly the ranks the ledger
+    says ever ran (a stream from a rank the ledger never admitted, or
+    no stream from a rank it did, stays loud)."""
+    from tpuic.telemetry.events import read_jsonl
+
+    ever: set = set()
+    transitions: List[dict] = []
+    for rec in read_jsonl(ledger_path):
+        ev = rec.get("event")
+        if ev == "membership":
+            active = [int(r) for r in rec.get("active", [])]
+            ever.update(active)
+            transitions.append({
+                "version": rec.get("version"),
+                "reason": rec.get("reason"),
+                "active": active, "rank": rec.get("rank"),
+                "resume_step": rec.get("resume_step"),
+                "t": rec.get("t")})
+        elif ev in ("spawn", "respawn") and rec.get("rank") is not None:
+            ever.add(int(rec["rank"]))
+    return {"ever_ranks": sorted(ever), "transitions": transitions}
+
+
 # -- the skew ledger ---------------------------------------------------------
 def aggregate(streams: Dict[int, List[dict]], warmup: int = 0) -> dict:
     """Merge per-rank event streams into the straggler-attribution
@@ -296,13 +330,30 @@ def main(argv=None) -> int:
                         "entirely (dead rank, wrong path) must fail "
                         "loudly, not have its skew silently computed "
                         "over whichever ranks showed up (the gang soak "
-                        "and multi-host runs pass their fleet size here)")
+                        "and multi-host runs pass their fleet size here). "
+                        "The STRICT gate — fixed-membership fleets; "
+                        "elastic runs pass --membership instead")
+    p.add_argument("--membership", default="", metavar="LEDGER",
+                   help="elastic coverage gate: the gang ledger "
+                        "(ledger.jsonl) whose membership timeline says "
+                        "which ranks legally joined/left mid-run — the "
+                        "streams must cover exactly the ranks that EVER "
+                        "ran (a shrink/grow is legal; a stream the "
+                        "ledger never admitted, or a missing member "
+                        "stream, still fails). Mutually exclusive with "
+                        "--require-ranks")
     args = p.parse_args(argv)
 
+    if args.require_ranks and args.membership:
+        print("[fleet] --require-ranks (strict) and --membership "
+              "(elastic timeline) are mutually exclusive",
+              file=sys.stderr)
+        return 2
     streams = load_streams(args.paths)
     if not streams:
         print("[fleet] no event streams found", file=sys.stderr)
         return 2
+    timeline = None
     if args.require_ranks:
         expected = set(range(args.require_ranks))
         missing = sorted(expected - set(streams))
@@ -314,7 +365,32 @@ def main(argv=None) -> int:
                   + (f"unexpected rank(s) {extra}" if extra else "")
                   + f" (found ranks {sorted(streams)})", file=sys.stderr)
             return 1
+    if args.membership:
+        timeline = membership_timeline(args.membership)
+        expected = set(timeline["ever_ranks"])
+        if not expected:
+            print(f"[fleet] FAIL: --membership {args.membership}: ledger "
+                  "carries no membership/spawn records — nothing to "
+                  "gate against", file=sys.stderr)
+            return 2
+        missing = sorted(expected - set(streams))
+        extra = sorted(set(streams) - expected)
+        if missing or extra:
+            print(f"[fleet] FAIL: --membership: "
+                  + (f"missing stream(s) for ledger member(s) {missing}"
+                     if missing else "")
+                  + (" and " if missing and extra else "")
+                  + (f"stream(s) from rank(s) the ledger never admitted "
+                     f"{extra}" if extra else "")
+                  + f" (found ranks {sorted(streams)}, ever-members "
+                  f"{sorted(expected)})", file=sys.stderr)
+            return 1
+        n_tr = len(timeline["transitions"])
+        print(f"[fleet] membership timeline: {len(expected)} ever-"
+              f"member(s), {n_tr} transition(s) — elastic coverage OK")
     report = aggregate(streams, warmup=max(0, args.warmup))
+    if timeline is not None:
+        report["membership"] = timeline
     for line in summary_lines(report):
         print(line)
     if args.json:

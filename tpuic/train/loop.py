@@ -78,35 +78,7 @@ class Trainer:
             self.train_ds = pack_dataset(self.train_ds, cache,
                                          verbose=is_host0())
             self.val_ds = pack_dataset(self.val_ds, cache, verbose=is_host0())
-        n_data = self.mesh.shape["data"]
-        global_batch = d.batch_size * n_data
-        # The device-cache HBM budget is a per-process TOTAL: the train
-        # loader claims first, val gets what remains (each dataset caches
-        # only when it fits its share — never 2x the configured budget).
-        cache_total = int(d.device_cache_mb) << 20
-        self.train_loader = Loader(self.train_ds, global_batch, step_mesh,
-                                   seed=d.shuffle_seed, num_workers=d.num_workers,
-                                   prefetch=d.prefetch, drop_last=True,
-                                   device_cache_bytes=cache_total,
-                                   augment=None if d.augment else False)
-        if self.train_loader.steps_per_epoch() == 0:
-            # drop_last with a fold smaller than ONE global batch would
-            # otherwise train zero steps per epoch while still writing
-            # checkpoints and reporting val numbers — a silent no-op run.
-            raise ValueError(
-                f"train fold has {len(self.train_ds)} images but the "
-                f"global batch is {global_batch} "
-                f"({d.batch_size}/chip x {n_data} data-parallel devices): "
-                "every epoch would train ZERO steps (the trailing partial "
-                "batch is dropped). Reduce --batchsize or the device "
-                "count, or add data.")
-        self.val_loader = Loader(self.val_ds,
-                                 d.resolved_val_batch_size() * n_data,
-                                 step_mesh, shuffle=False,
-                                 num_workers=d.num_workers, prefetch=d.prefetch,
-                                 device_cache_bytes=max(
-                                     0, cache_total
-                                     - self.train_loader.resident_bytes))
+        global_batch = self._build_loaders()
         num_classes = cfg.model.num_classes or self.train_ds.num_classes
         mcfg = cfg.model
         if num_classes != mcfg.num_classes:
@@ -139,8 +111,10 @@ class Trainer:
         self.mcfg = mcfg  # resolved model config (inferred num_classes)
         self.model = create_model_from_config(mcfg, mesh=self.mesh)
         steps = max(1, self.train_loader.steps_per_epoch())
-        self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs)
-        tx = make_optimizer(cfg.optim, steps, cfg.run.epochs)
+        self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs,
+                                      global_batch=global_batch)
+        tx = make_optimizer(cfg.optim, steps, cfg.run.epochs,
+                            global_batch=global_batch)
         shape = (global_batch, d.resize_size, d.resize_size, 3)
         with self.mesh:
             self.state = create_train_state(
@@ -161,14 +135,7 @@ class Trainer:
                 self.state, self.mesh, tp=cfg.mesh.tensor_parallel,
                 fsdp=cfg.mesh.fsdp, zero1=cfg.mesh.zero1)
             self.state = shard_state(self.state, self.state_sharding)
-        self.train_step = make_train_step(cfg.optim, mcfg, step_mesh,
-                                          lr_schedule=self.schedule,
-                                          seed=cfg.run.seed,
-                                          state_sharding=self.state_sharding)
-        self.eval_step = make_eval_step(
-            cfg.optim, mcfg, step_mesh, state_sharding=self.state_sharding,
-            per_sample=cfg.run.collect_misclassified,
-            per_class=cfg.run.per_class_metrics)
+        self._build_steps()
         self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
                                       cfg.run.save_period)
@@ -192,6 +159,18 @@ class Trainer:
         # agreement at fixed boundaries on multi-host pods.
         from tpuic.runtime.preemption import PreemptionGuard
         self.preemption = PreemptionGuard()
+        # Elastic fleet membership (runtime/membership.py, docs/
+        # parallelism.md "Elastic data parallelism"): when the elastic
+        # gang supervisor injected TPUIC_MEMBERSHIP_FILE, the loop polls
+        # it at step boundaries (one os.stat when unchanged) and a
+        # 'degrade' transition re-forms THIS process in place — restore
+        # from the fleet-agreed step through the capped integrity
+        # ladder, recompile if the local mesh shrank — with no process
+        # restart. None (the common case) costs nothing.
+        from tpuic.runtime.membership import MembershipWatcher
+        self.membership = MembershipWatcher.from_env()
+        self._reform_pending = None
+        self.reforms = 0
         self.logger = MetricLogger(log_dir)
         self.start_epoch = 0
         # Step offset into start_epoch (step-exact resume from a mid-epoch
@@ -276,6 +255,63 @@ class Trainer:
         if self.state_sharding is not None:
             from tpuic.parallel.sharding import shard_state
             self.state = shard_state(self.state, self.state_sharding)
+
+    def _build_loaders(self) -> int:
+        """Train/val Loaders for the CURRENT ``self.mesh`` — ONE
+        construction site shared by ``__init__`` and the elastic re-form
+        (``_rebuild_for_replicas``), so the two paths cannot drift: the
+        global batch is per-device batch x data extent, the device-cache
+        HBM budget is a per-process TOTAL (train claims first, val gets
+        the remainder — never 2x the configured budget), and a fold
+        smaller than one global batch fails loudly. Returns the global
+        batch."""
+        d = self.cfg.data
+        step_mesh = self.mesh if self.mesh.size > 1 else None
+        n_data = self.mesh.shape["data"]
+        global_batch = d.batch_size * n_data
+        cache_total = int(d.device_cache_mb) << 20
+        self.train_loader = Loader(self.train_ds, global_batch, step_mesh,
+                                   seed=d.shuffle_seed,
+                                   num_workers=d.num_workers,
+                                   prefetch=d.prefetch, drop_last=True,
+                                   device_cache_bytes=cache_total,
+                                   augment=None if d.augment else False)
+        if self.train_loader.steps_per_epoch() == 0:
+            # drop_last with a fold smaller than ONE global batch would
+            # otherwise train zero steps per epoch while still writing
+            # checkpoints and reporting val numbers — a silent no-op run.
+            raise ValueError(
+                f"train fold has {len(self.train_ds)} images but the "
+                f"global batch is {global_batch} "
+                f"({d.batch_size}/chip x {n_data} data-parallel devices): "
+                "every epoch would train ZERO steps (the trailing partial "
+                "batch is dropped). Reduce --batchsize or the device "
+                "count, or add data.")
+        self.val_loader = Loader(self.val_ds,
+                                 d.resolved_val_batch_size() * n_data,
+                                 step_mesh, shuffle=False,
+                                 num_workers=d.num_workers,
+                                 prefetch=d.prefetch,
+                                 device_cache_bytes=max(
+                                     0, cache_total
+                                     - self.train_loader.resident_bytes))
+        return global_batch
+
+    def _build_steps(self) -> None:
+        """(Re-)jit the train/eval steps for the CURRENT mesh, schedule,
+        and state sharding — shared by ``__init__`` and the elastic
+        re-form."""
+        cfg = self.cfg
+        step_mesh = self.mesh if self.mesh.size > 1 else None
+        self.train_step = make_train_step(cfg.optim, self.mcfg, step_mesh,
+                                          lr_schedule=self.schedule,
+                                          seed=cfg.run.seed,
+                                          state_sharding=self.state_sharding)
+        self.eval_step = make_eval_step(
+            cfg.optim, self.mcfg, step_mesh,
+            state_sharding=self.state_sharding,
+            per_sample=cfg.run.collect_misclassified,
+            per_class=cfg.run.per_class_metrics)
 
     def _loader_geometry(self):
         """(global_batch, seed, n_samples) — everything the epoch
@@ -381,6 +417,30 @@ class Trainer:
             if trig:
                 bar.close()
                 break
+            if self.membership is not None:
+                m = self.membership.poll()
+                if m is not None:
+                    if m.reason == "degrade" or (
+                            self.membership.skipped
+                            and m.resume_step is not None):
+                        # A peer died: re-form from the fleet-agreed
+                        # step (fit() runs the restore) instead of
+                        # training ahead of the membership the fleet
+                        # just agreed on. The second arm is the
+                        # coalesced case — the file holds only the
+                        # latest view, so a degrade overwritten by its
+                        # rejoin before this rank polled (a long val
+                        # pass) surfaces as a rejoin with skipped
+                        # versions and the cap aboard; restoring to the
+                        # cap is a deterministic replay either way.
+                        self._reform_pending = m
+                        bar.close()
+                        break
+                    # rejoin/restart transitions need no restore here —
+                    # note them so the stream shows the fleet view.
+                    _tm_publish("reform", reason=m.reason,
+                                version=m.version, active=list(m.active),
+                                resume_step=m.resume_step, acted=False)
             fbatch = {k: batch[k] for k in ("image", "label", "mask")}
             if _faults.fire("nan_batch", step=step0 + step):
                 # Poison this step's images host-side: same shapes/dtypes,
@@ -700,7 +760,8 @@ class Trainer:
             base_step = int(np.asarray(jax.device_get(self.state.step)))  # tpuic-ok: TPU101 rollback path, not steady state
             scale = rewarm_scale(base_step, run.rollback_rewarm_steps)
             self.state = self.state.replace(tx=make_optimizer(
-                self.cfg.optim, steps, run.epochs, lr_scale=scale))
+                self.cfg.optim, steps, run.epochs, lr_scale=scale,
+                global_batch=self.train_loader.global_batch))
             # The logged 'lr' metric must report what the optimizer now
             # APPLIES: fold the ramp into the metric schedule and rebuild
             # the step around it (one retrace — the same one the new tx
@@ -709,7 +770,9 @@ class Trainer:
             # so stacking onto an already-scaled self.schedule (rollback
             # #2 inside rollback #1's ramp) would under-report the LR.
             from tpuic.train.optimizer import make_schedule
-            base_sched = make_schedule(self.cfg.optim, steps, run.epochs)
+            base_sched = make_schedule(
+                self.cfg.optim, steps, run.epochs,
+                global_batch=self.train_loader.global_batch)
             self.schedule = lambda t: base_sched(t) * scale(t)
             self.train_step = make_train_step(
                 self.cfg.optim, self.mcfg,
@@ -731,6 +794,100 @@ class Trainer:
         _tm_publish("rollback", epoch=epoch, rollback=self.rollbacks,
                     rung=self.ckpt.last_restore_rung,
                     duration_s=round(time.perf_counter() - t_rb0, 3))
+        return epoch
+
+    def _rebuild_for_replicas(self, replicas: int) -> None:
+        """Re-form the in-process compute plane at a new data-parallel
+        extent — the "recompile, don't respawn" half of elastic
+        membership (docs/parallelism.md): a fresh mesh over the first
+        ``replicas`` replica slots (runtime/mesh.py ``replica_mesh``),
+        loaders re-sliced to the new global batch, schedule/optimizer
+        rebuilt in the new step time (the batch-scaled LR rule sees the
+        new global batch), state resharded onto the new mesh, and the
+        step functions re-jitted. Only reached when this process owns a
+        multi-replica mesh; an independent-rank fleet (mesh.size == 1)
+        has no local mesh to shrink and re-forms state only."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpuic.runtime.mesh import replica_mesh
+        cfg = self.cfg
+        self.mesh = replica_mesh(replicas, cfg.mesh)
+        step_mesh = self.mesh if self.mesh.size > 1 else None
+        global_batch = self._build_loaders()
+        steps = max(1, self.train_loader.steps_per_epoch())
+        self.schedule = make_schedule(cfg.optim, steps, cfg.run.epochs,
+                                      global_batch=global_batch)
+        self.state = self.state.replace(
+            tx=make_optimizer(cfg.optim, steps, cfg.run.epochs,
+                              global_batch=global_batch))
+        self.state_sharding = None
+        if step_mesh is not None and (cfg.mesh.fsdp or cfg.mesh.zero1 or (
+                cfg.mesh.tensor_parallel and self.mesh.shape["model"] > 1)):
+            from tpuic.parallel.sharding import shard_state, state_shardings
+            self.state_sharding = state_shardings(
+                self.state, self.mesh, tp=cfg.mesh.tensor_parallel,
+                fsdp=cfg.mesh.fsdp, zero1=cfg.mesh.zero1)
+            self.state = shard_state(self.state, self.state_sharding)
+        else:
+            # Replicated state must MOVE onto the shrunken mesh before
+            # the re-jitted step sees it: a leaf still laid out over the
+            # old R-device mesh fails the new program's device
+            # assignment instead of resharding silently.
+            repl = NamedSharding(self.mesh, P())
+            self.state = jax.tree.map(
+                lambda x: jax.device_put(x, repl), self.state)
+        self._build_steps()
+
+    def _do_reform(self, m) -> int:
+        """Act on a 'degrade' membership transition (docs/parallelism.md
+        "Elastic data parallelism"): shrink the local mesh if this
+        process owns one, then restore the fleet-agreed step through the
+        capped integrity ladder — all in-process (the pid is the proof;
+        the elastic soak pins it). Returns the epoch to continue from."""
+        self._reform_pending = None
+        self.reforms += 1
+        t0 = time.perf_counter()
+        # Commit any staged save first (the rollback discipline): the
+        # capped ladder must see every rung that exists.
+        self.ckpt.wait()
+        # Shrink the LOCAL mesh only when it IS the fleet (one process
+        # hosting all m.world replicas — rank ids map 1:1 onto replica
+        # slots, so "R' survivors" means "R' local replicas"). A
+        # multi-host rank whose local mesh spans several replicas can't
+        # equate fleet rank count with local extent (and which slots
+        # survived isn't local knowledge): there the membership/restore
+        # half applies and the mesh change rides the collective
+        # re-initialization (docs/parallelism.md, CPU-fleet caveat).
+        if (self.mesh.shape["data"] > 1
+                and m.world == self.mesh.shape["data"]
+                and 0 < len(m.active) < self.mesh.shape["data"]):
+            self._rebuild_for_replicas(len(m.active))
+        self.state, epoch, restored_best = self.ckpt.restore_into(
+            self.state, resume_cap=m.resume_step)
+        self.best_score = max(self.best_score, restored_best)
+        if self.state.skip_count is not None:
+            import jax.numpy as jnp
+            self.state = self.state.replace(
+                skip_count=jnp.zeros((), jnp.int32))
+        if self.state_sharding is not None:
+            from tpuic.parallel.sharding import shard_state
+            self.state = shard_state(self.state, self.state_sharding)
+        self.start_epoch = epoch
+        self.start_step = self._validated_start_step()
+        self._last_skip_streak = 0
+        what = (f"fleet degraded to {len(m.active)}/{m.world} "
+                f"(rank {m.rank} lost)" if m.reason == "degrade"
+                else f"coalesced '{m.reason}' transition (a degrade came "
+                     f"and went between polls; fleet at "
+                     f"{len(m.active)}/{m.world})")
+        host0_print(
+            f"[elastic] membership v{m.version}: {what} — re-formed "
+            f"in place from fleet-agreed step {m.resume_step} (rung "
+            f"'{self.ckpt.last_restore_rung}'); continuing at epoch "
+            f"{epoch} step {self.start_step}, no process restart")
+        _tm_publish("reform", reason=m.reason, version=m.version,
+                    active=list(m.active), resume_step=m.resume_step,
+                    acted=True, epoch=epoch, rung=self.ckpt.last_restore_rung,
+                    duration_s=round(time.perf_counter() - t0, 3))
         return epoch
 
     def fit(self, epochs: Optional[int] = None) -> float:
@@ -776,6 +933,15 @@ class Trainer:
                         jax.profiler.stop_trace()
                         profiled = False
                     epoch = self._do_rollback()
+                    best = self.best_score
+                    continue
+                if self._reform_pending is not None:
+                    # Elastic degrade: a peer died; re-form in place from
+                    # the fleet-agreed step and continue from ITS epoch.
+                    if profiled:
+                        jax.profiler.stop_trace()
+                        profiled = False
+                    epoch = self._do_reform(self._reform_pending)
                     best = self.best_score
                     continue
                 if self._steps_exhausted:

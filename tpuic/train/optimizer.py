@@ -16,7 +16,32 @@ from tpuic.config import OptimConfig
 from tpuic.train import schedule as sched
 
 
-def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int) -> optax.Schedule:
+def make_schedule(cfg: OptimConfig, steps_per_epoch: int, total_epochs: int,
+                  global_batch: int = 0) -> optax.Schedule:
+    """The config's LR schedule in optimizer-step time.
+
+    ``global_batch`` + ``cfg.base_batch_size`` engage the Goyal
+    linear-scaling rule (train/schedule.py
+    ``batch_scaled_warmup_schedule``): peak LR scaled by
+    global_batch/base_batch, reached by a linear ramp from the unscaled
+    base LR over ``warmup_epochs``, with the config's normal schedule
+    (milestones / cosine / constant) built at the scaled peak taking
+    over after the ramp. With base_batch_size unset (the default) the
+    behavior is bitwise the old one."""
+    if cfg.base_batch_size and global_batch:
+        peak = cfg.learning_rate * global_batch / cfg.base_batch_size
+        if cfg.milestones and not cfg.warmup_epochs:
+            main = sched.multistep_schedule(peak, cfg.milestones,
+                                            cfg.gamma, steps_per_epoch)
+        elif cfg.warmup_epochs > 0:
+            main = sched.warmup_cosine_schedule(peak, cfg.warmup_epochs,
+                                                total_epochs,
+                                                steps_per_epoch)
+        else:
+            main = sched.constant_schedule(peak)
+        return sched.batch_scaled_warmup_schedule(
+            cfg.learning_rate, global_batch, cfg.base_batch_size,
+            max(1, cfg.warmup_epochs), steps_per_epoch, main)
     if cfg.warmup_epochs > 0:
         return sched.warmup_cosine_schedule(cfg.learning_rate, cfg.warmup_epochs,
                                             total_epochs, steps_per_epoch)
@@ -47,7 +72,8 @@ def rewarm_scale(start_step: int, rewarm_steps: int):
 
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
                    total_epochs: int = 100,
-                   lr_scale=None) -> optax.GradientTransformation:
+                   lr_scale=None,
+                   global_batch: int = 0) -> optax.GradientTransformation:
     # Under gradient accumulation the inner transform's schedule counter
     # advances once per REAL update (1 in K micro-steps), so map it back to
     # micro-step time: lr(t_real) = micro_schedule(t_real * K). Exact for
@@ -55,7 +81,8 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
     # would floor-drift milestones on small datasets), and identical to
     # the Trainer's micro-step logging schedule in data time.
     k = max(1, cfg.grad_accum_steps)
-    base = make_schedule(cfg, steps_per_epoch, total_epochs)
+    base = make_schedule(cfg, steps_per_epoch, total_epochs,
+                         global_batch=global_batch)
     if lr_scale is not None:
         # Multiplicative override in MICRO-step time (state.step), e.g.
         # rewarm_scale after a rollback; composed before the accumulation
@@ -70,9 +97,24 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
         if cfg.weight_decay:
             tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
     elif name == "lars":
+        # Layer-wise Adaptive Rate Scaling (You et al., arXiv:1708.03888;
+        # the BASELINE.md config-5 / 15-minute-ImageNet optimizer): each
+        # layer's update is rescaled by the trust ratio
+        # eta * ||w|| / (||g|| + wd * ||w||), so layers whose gradients
+        # are large relative to their weights can't blow up at
+        # large-batch LRs. Golden-value-pinned against an independent
+        # numpy reference in tests/test_optimizer.py.
         tx = optax.lars(lr, weight_decay=cfg.weight_decay,
                         trust_coefficient=cfg.lars_trust_coefficient,
                         momentum=cfg.lars_momentum)
+    elif name == "lamb":
+        # LAMB (You et al., arXiv:1904.00962): the Adam-flavored sibling
+        # — Adam moments first, then the per-layer trust ratio
+        # ||w|| / ||adam_update + wd * w|| rescales each layer's step.
+        # The large-batch recipe for attention models (ViT) where plain
+        # LARS underperforms; golden-pinned next to LARS.
+        tx = optax.lamb(lr, b1=cfg.lamb_b1, b2=cfg.lamb_b2,
+                        eps=cfg.lamb_eps, weight_decay=cfg.weight_decay)
     elif name == "sgd":
         tx = optax.sgd(lr, momentum=0.9)
         if cfg.weight_decay:

@@ -52,6 +52,31 @@ class TrainState(struct.PyTreeNode):
                             opt_state=new_opt_state)
 
 
+def opt_state_bytes(state: TrainState) -> int:
+    """GLOBAL byte size of the optimizer state (every array leaf's full
+    logical extent) — the denominator of the ZeRO 1/R memory claim."""
+    return sum(int(leaf.nbytes)
+               for leaf in jax.tree_util.tree_leaves(state.opt_state)
+               if hasattr(leaf, "nbytes"))
+
+
+def opt_state_device_bytes(state: TrainState,
+                           device: jax.Device) -> int:
+    """Bytes of optimizer state RESIDENT on ``device`` — per-shard, not
+    logical: a leaf sharded over the ``data`` axis (ZeRO-1,
+    tpuic/parallel/sharding.py) charges ``nbytes / R`` here while a
+    replicated leaf charges its full size. The measured quantity behind
+    perf/elastic_zero.json (optimizer memory per replica ~ 1/R)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if not isinstance(leaf, jax.Array):
+            continue
+        for shard in leaf.addressable_shards:
+            if shard.device == device:
+                total += int(shard.data.nbytes)
+    return total
+
+
 def create_train_state(model, tx: optax.GradientTransformation, rng: jax.Array,
                        input_shape, train: bool = True,
                        ema: bool = False) -> TrainState:

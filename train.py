@@ -45,7 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resize", type=int, default=299)
     p.add_argument("--epochs", type=int, default=100)
     p.add_argument("--lr", type=float, default=0.5e-5)
-    p.add_argument("--optimizer", default="adam", choices=["adam", "lars", "sgd"])
+    p.add_argument("--optimizer", default="adam",
+                   choices=["adam", "lars", "lamb", "sgd"],
+                   help="'lars'/'lamb' are the layer-wise trust-ratio "
+                        "large-batch optimizers (arXiv:1708.03888 / "
+                        "1904.00962); pair them with --base-batch for "
+                        "the linear-scaling warmup")
     p.add_argument("--milestones", type=int, nargs="*", default=[50, 80])
     p.add_argument("--gamma", type=float, default=0.5)
     p.add_argument("--weight-decay", type=float, default=0.0)
@@ -63,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-sample probability of erasing a random box "
                         "on-device in the train step (0 = off)")
     p.add_argument("--warmup-epochs", type=int, default=0)
+    p.add_argument("--base-batch", type=int, default=0, metavar="N",
+                   help="Goyal linear-scaling rule: peak LR = --lr * "
+                        "global_batch / N, reached by a linear warmup "
+                        "from --lr over --warmup-epochs (0 = off). The "
+                        "global batch tracks the data-parallel extent, "
+                        "so one config survives fleet growth and "
+                        "elastic degrade alike")
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="accumulate gradients over K steps before one "
                         "optimizer update (effective batch = K * global)")
@@ -271,6 +283,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           cutmix_alpha=args.cutmix,
                           random_erase=args.random_erase,
                           warmup_epochs=args.warmup_epochs,
+                          base_batch_size=args.base_batch,
                           grad_accum_steps=args.grad_accum_steps,
                           label_smoothing=args.label_smoothing,
                           ema_decay=args.ema_decay,
