@@ -115,8 +115,9 @@ def _measure(platform: str) -> dict:
             warn=lambda msg: print(f"[bench] WARNING: {msg}",
                                    file=sys.stderr))
     except Exception:
-        # Analytic fwd+bwd estimate — the telemetry subsystem's formula
-        # (numerically identical to the old inline 3*2*4.1e9*B/2).
+        # Analytic fwd+bwd estimate — the telemetry subsystem's formula.
+        # (2x the old inline 3*2*4.1e9*B/2: that constant was the GMAC
+        # count pasted as FLOPs, fixed by the PR-16 zoo cross-check.)
         flops_per_step = analytic_flops_per_step("resnet50", size,
                                                  global_batch)
 

@@ -139,6 +139,31 @@ CASES = [
             check(state)  # rebound: this is the NEW buffer
             return state
         """),
+    # Cast-then-donate (the bf16 tier's idiom): metadata attributes
+    # (.dtype/.shape/.ndim/.size) live on the host-side array object and
+    # survive donation — only a VALUE read of the surrendered buffer is
+    # the bug.
+    ("TPU201", "pkg/mod.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def run(step, state, batch):
+            x16 = batch.astype(jnp.bfloat16)
+            step = jax.jit(step, donate_argnums=(0,))
+            out = step(x16, state)
+            y = x16 + 1  # value read after donation
+            return out, y
+        """, """
+        import jax
+        import jax.numpy as jnp
+
+        def run(step, state, batch):
+            x16 = batch.astype(jnp.bfloat16)
+            step = jax.jit(step, donate_argnums=(0,))
+            out = step(x16, state)
+            log(x16.dtype, x16.shape)  # metadata only: buffer untouched
+            return out
+        """),
     # The PR-2 regression fixture: lax.cond inside a donated jit — the
     # exact bisected cond+donation+compile-cache shape from
     # tpuic/train/step.py (there: suppressed with the measured

@@ -58,6 +58,7 @@ def test_vit_b16_token_count():
     assert variables["params"]["pos_embed"].shape == (1, 197, 768)
 
 
+@pytest.mark.slow  # ~21 s CPU: test_train_step_with_inception_aux_loss keeps aux coverage tier-1
 def test_inception_aux_in_train_mode_only():
     model = create_model("inceptionv3", 7, dtype="float32")
     x = jnp.zeros((1, 299, 299, 3), jnp.float32)
@@ -101,6 +102,7 @@ def test_train_step_with_inception_aux_loss():
     assert int(new_state.step) == 1
 
 
+@pytest.mark.slow  # ~27 s CPU: b4/b7 construction; b0 shape test keeps the family tier-1
 def test_efficientnet_b4_b7_registered_and_scaled():
     """b4-b7 compound scaling: registered, and widths/depths grow per the
     published coefficients (feature width = round_filters(1280, w))."""
@@ -123,6 +125,7 @@ def test_efficientnet_b4_b7_registered_and_scaled():
     assert _round_filters(1280, _SCALING["b7"][0]) == 2560
 
 
+@pytest.mark.slow  # ~17 s CPU: biggest-model registration; zoo FLOPs sweep keeps them built nightly
 def test_resnet152_and_vit_l16_registered():
     from tpuic.models import available_models
     assert "resnet152" in available_models()

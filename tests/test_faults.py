@@ -712,3 +712,99 @@ def test_serve_drain_timeout_fails_stragglers(tmp_path, monkeypatch, capsys):
     lines = [json.loads(ln) for ln in out.read_text().splitlines()]
     assert {ln["id"] for ln in lines} == {"im_0.png", "im_1.png"}
     assert any("drain timeout" in ln.get("error", "") for ln in lines)
+
+
+# -- deferred (async) checkpoint commits ------------------------------------
+def test_async_commit_lands_without_wait(tmp_path):
+    """async_commit=True: the stage -> manifest -> rotate pipeline runs on
+    the background thread — the track becomes restorable WITHOUT the loop
+    ever blocking in wait(), and the commit event is flagged
+    blocking=False (the goodput tracker's cue to keep the 'checkpoint'
+    bucket at ~0)."""
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.telemetry.events import bus
+
+    events = []
+    unsub = bus.subscribe(events.append, kinds=("checkpoint_commit",))
+    try:
+        a = _ckpt_state(0)
+        mgr = CheckpointManager(str(tmp_path), "m", async_commit=True)
+        mgr.save_latest(a, epoch=1, best_score=10.0)
+        deadline = time.monotonic() + 30.0
+        track = os.path.join(str(tmp_path), "m", "latest")
+        while time.monotonic() < deadline:
+            if os.path.exists(track + ".manifest.json"):
+                break
+            time.sleep(0.02)
+        assert os.path.exists(track + ".manifest.json"), \
+            "deferred commit never landed"
+    finally:
+        unsub()
+    commits = [e for e in events if e.data.get("phase") == "commit"]
+    assert commits and commits[0].data.get("blocking") is False
+    # wait() after the thread finished is a no-op join; restore sees it.
+    mgr.wait()
+    restored, epoch, best = mgr.restore_into(_ckpt_state(2), "latest")
+    assert (epoch, best) == (2, 10.0)
+    for x, y in zip(_leaves(a.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_kill_in_deferred_commit_restores_previous_rung(tmp_path):
+    """ckpt_kill on the DEFERRED path: the background thread dies between
+    the staged write and the rotation; the error surfaces at the next
+    wait() (the crash window just moves to the next sync point) and the
+    previous committed rung restores untouched via the existing ladder."""
+    from tpuic.checkpoint.manager import CheckpointManager
+
+    a, b = _ckpt_state(0), _ckpt_state(1)
+    mgr = CheckpointManager(str(tmp_path), "m", async_commit=True)
+    mgr.save_latest(a, epoch=1, best_score=10.0)
+    mgr.wait()
+    faults.arm("ckpt_kill")
+    mgr.save_latest(b, epoch=2, best_score=20.0)
+    with pytest.raises(faults.InjectedFault):
+        mgr.wait()  # joins the commit thread, re-raises what it hit
+    faults.reset()
+    mgr2 = CheckpointManager(str(tmp_path), "m", async_commit=True)
+    restored, epoch, best = mgr2.restore_into(_ckpt_state(2), "latest")
+    assert (epoch, best) == (2, 10.0)  # epoch-1 save -> resume at 2
+    for x, y in zip(_leaves(a.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(x, y)
+    # Retry works, exactly like the blocking path.
+    mgr2.save_latest(b, epoch=2, best_score=20.0)
+    mgr2.wait()
+    restored, epoch, best = mgr2.restore_into(_ckpt_state(2), "latest")
+    assert (epoch, best) == (3, 20.0)
+
+
+def test_gang_never_sees_uncommitted_deferred_rung(tmp_path):
+    """fleet agreement safety: while a deferred commit is staged-but-dead
+    (ckpt_kill between write and rotation), gang committed_steps /
+    fleet_resume_step still report the PREVIOUS rung — a rank can never
+    advertise a step the fleet can't restore."""
+    from tpuic.checkpoint.manager import CheckpointManager
+    from tpuic.runtime.gang import committed_steps, fleet_resume_step
+
+    a, b = _ckpt_state(0), _ckpt_state(1)
+    mgr = CheckpointManager(str(tmp_path), "m", async_commit=True)
+    mgr.save_latest(a, epoch=1, best_score=10.0)
+    mgr.wait()
+    root = os.path.join(str(tmp_path), "m")
+    before = committed_steps(root)
+    assert "latest" in before
+    faults.arm("ckpt_kill")
+    mgr.save_latest(b, epoch=2, best_score=20.0)
+    # Let the background thread reach (and die at) the injected kill
+    # WITHOUT calling wait(): this is exactly the window where a buggy
+    # implementation would have already advertised the new rung.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and faults.fired("ckpt_kill") == 0:
+        time.sleep(0.02)
+    assert faults.fired("ckpt_kill") == 1
+    t = mgr._commit_thread
+    if t is not None:
+        t.join(30.0)
+    faults.reset()
+    assert committed_steps(root) == before
+    assert fleet_resume_step([root]) == before["latest"]

@@ -244,6 +244,7 @@ def test_swap_accuracy_gate_refuses_nan_candidate(tmp_path):
         eng.close()
 
 
+@pytest.mark.slow  # ~20 s CPU: ladder-wide swap gate; single-rung swap gates stay tier-1
 def test_swap_accuracy_gate_refuses_disagreeing_ladder_rung(
         tmp_path, monkeypatch):
     """The PR-13 startup gate re-run per swap: a quantization path that

@@ -1,0 +1,194 @@
+"""bf16 mixed-precision training tier (ModelConfig.compute_dtype).
+
+The contract under --compute-dtype bf16: forward/backward run in
+bfloat16 (batch cast at step entry, flax in-module param casts), the
+loss is computed on f32 logits, and the DIFFERENTIATED state never
+leaves f32 — master weights, optimizer moments, checkpoints.  The
+convergence-parity gate lives in scripts/bf16_parity.py; these tests
+pin the mechanics it relies on.
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuic.config import ModelConfig, OptimConfig, resolve_compute_dtype
+from tpuic.data.synthetic import synthetic_batch
+from tpuic.models import create_model
+from tpuic.runtime import faults
+from tpuic.train.optimizer import make_optimizer
+from tpuic.train.state import create_train_state
+from tpuic.train.step import make_train_step
+
+OCFG = OptimConfig(optimizer="lars", learning_rate=1e-3, class_weights=(),
+                   milestones=())
+
+
+def _mcfg(compute_dtype):
+    # Mirror the Trainer's resolution: the policy forces the model dtype.
+    dtype = {"bf16": "bfloat16", "f32": "float32", "": "float32"}[
+        compute_dtype]
+    return ModelConfig(name="resnet18-cifar", num_classes=3, dtype=dtype,
+                       compute_dtype=compute_dtype)
+
+
+def _state(mcfg, ocfg=OCFG, batch=4, size=32):
+    model = create_model(mcfg.name, mcfg.num_classes, dtype=mcfg.dtype)
+    tx = make_optimizer(ocfg)
+    return create_train_state(model, tx, jax.random.key(0),
+                              (batch, size, size, 3))
+
+
+def _batch(n=4, size=32, seed=0):
+    return {k: jnp.asarray(v) for k, v in
+            synthetic_batch(n, size, 3, seed=seed).items()}
+
+
+def test_resolve_compute_dtype_spellings_and_validation():
+    for raw, want in (("", ""), ("bf16", "bf16"), ("bfloat16", "bf16"),
+                      ("BF16", "bf16"), ("f32", "f32"), ("float32", "f32")):
+        m = ModelConfig(name="resnet18", compute_dtype=raw)
+        assert resolve_compute_dtype(m) == want
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ModelConfig(name="resnet18", compute_dtype="fp16")
+    with pytest.raises(ValueError, match="loss_scale"):
+        OptimConfig(optimizer="lars", learning_rate=1e-3, class_weights=(),
+                    milestones=(), loss_scale=0.0)
+
+
+def test_bf16_step_keeps_master_state_f32():
+    """Two bf16 steps: params move, loss is finite, and every
+    differentiated leaf (params + optimizer moments) stays float32."""
+    mcfg = _mcfg("bf16")
+    state = _state(mcfg)
+    step = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+    batch = _batch()
+    s1, m1 = step(state, batch)
+    s2, m2 = step(s1, batch)
+    assert np.isfinite(float(m2["loss"]))
+    before = jax.tree.leaves(state.params)
+    after = jax.tree.leaves(s2.params)
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
+    for leaf in jax.tree.leaves(s2.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves(s2.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+def test_bf16_arm_casts_batch_f32_arm_does_not():
+    """Structural proof the policy engages: the lowered bf16 step
+    contains bfloat16 ops, the f32 step contains none."""
+    batch = _batch()
+    for tag, want in (("bf16", True), ("f32", False)):
+        mcfg = _mcfg(tag)
+        state = _state(mcfg)
+        step = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+        txt = step.lower(state, batch).as_text()
+        assert ("bf16" in txt) is want, tag
+
+
+def test_loss_scale_is_an_exact_noop_in_f32():
+    """Static loss scaling: scale the loss, unscale loss and grads — in
+    f32 the trajectory is unchanged (the knob exists for bf16 underflow
+    stress, off by default)."""
+    mcfg = _mcfg("f32")
+    batch = _batch()
+    outs = []
+    for scale in (1.0, 256.0):
+        ocfg = dataclasses.replace(OCFG, loss_scale=scale)
+        state = _state(mcfg, ocfg)
+        step = make_train_step(ocfg, mcfg, mesh=None, donate=False)
+        s, m = step(state, batch)
+        outs.append((float(m["loss"]),
+                     np.asarray(jax.tree.leaves(s.params)[0])))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-5,
+                               atol=1e-8)
+
+
+@pytest.mark.slow  # ~9 s CPU: scripts/bf16_parity.py gates this bidirectionally in CI
+def test_bf16_tracks_f32_short_run():
+    """4 steps on the same data: the bf16 arm's loss stays close to the
+    f32 arm's — the cheap in-suite echo of the scripts/bf16_parity.py
+    convergence gate."""
+    batch = _batch()
+    finals = {}
+    for tag in ("f32", "bf16"):
+        mcfg = _mcfg(tag)
+        state = _state(mcfg)
+        step = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+        for _ in range(4):
+            state, m = step(state, batch)
+        finals[tag] = float(m["loss"])
+    assert abs(finals["bf16"] - finals["f32"]) / finals["f32"] < 0.05, finals
+
+
+def test_bf16_master_truncate_fault_breaks_parity():
+    """The seeded mixed-precision bug (bf16_master_truncate): armed, the
+    compiled step's updated params are exactly bf16-representable — the
+    no-f32-master mistake the parity gate must catch; unarmed they are
+    not. Trace-time inject, so each arm compiles its own step."""
+    mcfg = _mcfg("bf16")
+    batch = _batch()
+
+    def rounded(state):
+        leaves = [np.asarray(p) for p in jax.tree.leaves(state.params)]
+        return all(
+            np.array_equal(p, np.asarray(jnp.asarray(p).astype(
+                jnp.bfloat16).astype(jnp.float32))) for p in leaves)
+
+    state = _state(mcfg)
+    step = make_train_step(OCFG, mcfg, mesh=None, donate=False)
+    clean, _ = step(state, batch)
+    assert not rounded(clean)
+    faults.arm("bf16_master_truncate")
+    try:
+        step_bad = make_train_step(OCFG, mcfg, mesh=None, donate=False,
+                                   seed=1)
+        bad, _ = step_bad(_state(mcfg), batch)
+    finally:
+        faults.reset()
+    assert rounded(bad)
+
+
+def test_donation_warning_names_compute_dtype(tmp_path):
+    """The cpu+cache+guard donation auto-disable warning must tell the
+    reader the new knob is NOT the culprit (cast sites produce fresh
+    arrays) — the message names ModelConfig.compute_dtype explicitly."""
+    old = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    try:
+        ocfg = dataclasses.replace(OCFG, skip_nonfinite=True)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            make_train_step(ocfg, _mcfg("bf16"), mesh=None, donate=True)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+    msgs = [str(w.message) for w in rec
+            if "disabling train-state donation" in str(w.message)]
+    assert msgs and "compute_dtype" in msgs[0] \
+        and "--compute-dtype" in msgs[0]
+
+
+def test_cli_wires_compute_dtype_and_loss_scale():
+    import train as train_cli
+    args = train_cli.build_parser().parse_args(
+        ["--datadir", "/tmp/x", "--compute-dtype", "bf16",
+         "--loss-scale", "128"])
+    cfg = train_cli.config_from_args(args)
+    assert cfg.model.compute_dtype == "bf16"
+    assert cfg.optim.loss_scale == 128.0
+    default = train_cli.config_from_args(
+        train_cli.build_parser().parse_args(["--datadir", "/tmp/x"]))
+    assert default.model.compute_dtype == ""
+    assert default.optim.loss_scale == 1.0
+    assert default.run.async_checkpoint is True
+    no_async = train_cli.config_from_args(train_cli.build_parser().parse_args(
+        ["--datadir", "/tmp/x", "--no-async-checkpoint"]))
+    assert no_async.run.async_checkpoint is False

@@ -144,10 +144,10 @@ def test_check_flops_drift_warns_past_tolerance():
     with warnings.catch_warnings():
         warnings.simplefilter("error")  # within 10%: silent
         d = check_flops_drift("resnet50", 224, 8,
-                              1.05 * 3 * 2 * 4.1e9 * 8 / 2)
+                              1.05 * 3 * 8.2e9 * 8)
         assert d == pytest.approx(0.05, abs=0.01)
     seen = []
-    d = check_flops_drift("resnet50", 224, 8, 2 * 3 * 2 * 4.1e9 * 8 / 2,
+    d = check_flops_drift("resnet50", 224, 8, 2 * 3 * 8.2e9 * 8,
                           warn=seen.append)
     assert d == pytest.approx(0.5)
     assert len(seen) == 1 and "drifts" in seen[0]

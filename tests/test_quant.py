@@ -119,6 +119,7 @@ class TestServeVariants:
 
 
 class TestEngineDtypeLadder:
+    @pytest.mark.slow  # ~22 s CPU: compiles the full dtype ladder; accuracy-gate tests stay tier-1
     def test_per_dtype_executables_zero_steady_compiles(self):
         """The engine-side contract (docs/performance.md): one AOT
         cache keyed (variant, bucket), mixed-dtype traffic batches

@@ -342,6 +342,7 @@ def _stub_forward(variables, images):
     return s
 
 
+@pytest.mark.slow  # ~17 s CPU: CI runs the regress gate as its own step; keep the unit lane lean
 def test_regress_serve_workload_bidirectional():
     """The gate proof on the REAL engine workload: a clean re-run passes
     against a just-written baseline; the same workload under a seeded

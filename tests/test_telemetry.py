@@ -165,19 +165,21 @@ def test_goodput_buckets_and_accounting():
 
 
 def test_mfu_math_matches_bench_golden():
-    """The analytic FLOPs moved out of bench.py must be numerically
-    identical to bench.py's historical inline math, and bench.py must be
-    importing THIS table (one source of truth)."""
+    """The analytic-FLOPs scaling semantics (3x train, quadratic
+    resolution) stay pinned, and bench.py must be importing THIS table
+    (one source of truth).  The resnet50 basis is 8.2e9 = 2 * 4.1
+    GMACs: bench.py's historical inline 3*2*4.1e9*B/2 had pasted the
+    literature MAC count as FLOPs — 2x low, caught by the
+    tests/test_flops_zoo.py compiler cross-check (PR 16)."""
     B = 8
-    # bench.py's old fallback: 3 * 2 * 4.1e9 * global_batch / 2
     assert analytic_flops_per_step("resnet50", 224, B) == \
-        pytest.approx(3 * 2 * 4.1e9 * B / 2)
+        pytest.approx(3 * 8.2e9 * B)
     # resolution scaling is quadratic in side length
     assert analytic_flops_per_step("resnet50", 112, B) == \
-        pytest.approx(3 * 4.1e9 * B * 0.25)
+        pytest.approx(3 * 8.2e9 * B * 0.25)
     # eval = forward only
     assert analytic_flops_per_step("resnet50", 224, B, train=False) == \
-        pytest.approx(4.1e9 * B)
+        pytest.approx(8.2e9 * B)
     # longest-prefix: the cifar variant gets its own entry, not resnet18's
     assert analytic_flops_per_step("resnet18-cifar", 32, 4) == \
         pytest.approx(3 * FWD_FLOPS_PER_IMAGE["resnet18-cifar"][0] * 4)

@@ -37,6 +37,7 @@ def _randomize_bn(model):
                 m.running_var.uniform_(0.5, 1.5)
 
 
+@pytest.mark.slow  # ~35 s CPU: full Inception torch+flax forward; b4 parity keeps arch coverage tier-1
 def test_inception_forward_parity():
     torch.manual_seed(4)
     tm = build_inception(num_classes=7).eval()
@@ -82,6 +83,7 @@ def test_inception_aux_conversion_shapes():
 # TF-style SAME padding)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~18 s CPU: b0 parity; test_efficientnet_b4_forward_parity keeps parity tier-1
 def test_efficientnet_forward_parity():
     torch.manual_seed(7)
     tm = build_efficientnet('b0', num_classes=7).eval()

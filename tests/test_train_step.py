@@ -427,6 +427,7 @@ class TestMixup:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] != losses[0]  # per-step lambda varies + learning
 
+    @pytest.mark.slow  # ~16 s CPU: 8-way mesh Mixup parity; single-device Mixup tests stay tier-1
     def test_mesh_matches_single_device(self, devices8):
         """The permutation gather composes with batch sharding: 8-device
         mixup step == single-device mixup step bitwise-close."""

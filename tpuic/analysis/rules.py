@@ -619,6 +619,16 @@ class Analyzer:
                         # name to the RESULT — the surrendered buffer is
                         # no longer reachable, which is the correct idiom.
                         donated_calls.append((end, name))
+        # Static-metadata reads survive donation: `x.dtype` / `x.shape` /
+        # `x.ndim` / `x.size` live on the (host-side) array object, not in
+        # the surrendered device buffer. The bf16 tier's cast-then-donate
+        # sites (`x16 = x.astype(bf16); out = step(x16); log(x16.dtype)`)
+        # are the common benign shape — only a VALUE read after donation
+        # is the bug.
+        static_reads = {
+            id(a.value) for a in own
+            if isinstance(a, ast.Attribute)
+            and isinstance(a.value, ast.Name) and a.attr in _STATIC_ATTRS}
         for call_line, name in donated_calls:
             later = sorted(
                 (n for n in own if isinstance(n, ast.Name)
@@ -627,6 +637,8 @@ class Analyzer:
             for n in later:
                 if isinstance(n.ctx, ast.Store):
                     break  # rebound: the old buffer is gone cleanly
+                if id(n) in static_reads:
+                    continue  # metadata-only read; buffer untouched
                 self.add("TPU201", n,
                          f"'{name}' was donated to a jitted call on line "
                          f"{call_line} and is read here — the buffer may "

@@ -38,6 +38,7 @@ import json
 import os
 import shutil
 import signal
+import threading
 import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
@@ -158,9 +159,23 @@ def _remove(path: str) -> None:
 class CheckpointManager:
     """best/latest checkpoint tracks under ``{ckpt_dir}/{name}``."""
 
-    def __init__(self, ckpt_dir: str, name: str, save_period: int = 5) -> None:
+    def __init__(self, ckpt_dir: str, name: str, save_period: int = 5,
+                 async_commit: bool = False) -> None:
         self.root = os.path.abspath(os.path.join(ckpt_dir, name))
         self.save_period = save_period
+        # Deferred commits (RunConfig.async_checkpoint): _save spawns a
+        # background thread that drains the Orbax write and runs the
+        # SAME stage -> manifest -> rotate commit, so the train loop's
+        # goodput 'checkpoint' bucket sees ~0 blocking seconds. The
+        # contract is unchanged — a commit can only become visible
+        # EARLIER, never differently: every reader still enters through
+        # wait(), which joins the thread (re-raising anything it hit)
+        # before looking at the tracks. Single-process only; multi-host
+        # commits are collective (the _commit_barrier) and stay
+        # synchronous.
+        self._async_commit = bool(async_commit)
+        self._commit_thread: Optional[threading.Thread] = None
+        self._commit_error: Optional[BaseException] = None
         # Async: save() hands Orbax the (possibly sharded) on-device arrays
         # and returns; serialization + write happen on a background thread.
         try:
@@ -230,8 +245,28 @@ class CheckpointManager:
         through here first, so a checkpoint becomes visible atomically or
         not at all. ``faults`` point ``ckpt_kill`` fires between the
         finished write and the rotation — the SIGKILL-mid-save simulation:
-        the committed track must be untouched by the aborted save."""
+        the committed track must be untouched by the aborted save.
+
+        With deferred commits, wait() first joins the background commit
+        thread and re-raises anything it hit (an injected ``ckpt_kill``
+        included — the crash window just moves to the next sync point);
+        only one thread ever touches ``_pending``/``_ckptr`` because every
+        entry point joins before proceeding."""
         t0 = time.perf_counter()
+        thread, self._commit_thread = self._commit_thread, None
+        if thread is not None:
+            thread.join()
+            err, self._commit_error = self._commit_error, None
+            if err is not None:
+                raise err
+        self._drain_and_commit(t0, blocking=True)
+
+    def _drain_and_commit(self, t0: float, blocking: bool = True) -> None:
+        """Drain the in-flight Orbax write, then run the atomic commit
+        (manifest over staged bytes -> rotation -> sidecar -> event).
+        ``blocking=False`` marks a deferred commit running concurrently
+        with compute — the goodput tracker then books its span outside
+        the wall-clock 'checkpoint' bucket."""
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
         pending, self._pending = self._pending, None
@@ -288,7 +323,7 @@ class CheckpointManager:
         # 'checkpoint' bucket.
         _tm_publish("checkpoint_commit", track=track,
                     epoch=int(pending["epoch"]), step=int(pending["step"]),
-                    phase="commit",
+                    phase="commit", blocking=bool(blocking),
                     duration_s=round(time.perf_counter() - t0, 3))
         self._commit_barrier()
 
@@ -332,6 +367,26 @@ class CheckpointManager:
                          "data_len": int(data_len),
                          # reuse _payload's device_get — one sync per save
                          "step": int(payload["meta"]["step"])}
+        if self._async_commit and jax.process_count() == 1:
+            # Deferred commit: drain + manifest + rotation run concurrently
+            # with the next train steps instead of stalling the loop at the
+            # next natural wait(). A rank can only ever advertise a commit
+            # EARLIER than the blocking path would have — never a rung the
+            # ladder can't restore: until the rotation lands the track is
+            # byte-identical to the previous committed save, and gang
+            # committed_steps / fleet_resume_step read track manifests,
+            # which this thread writes last-but-one before the renames.
+            t1 = time.perf_counter()
+
+            def _bg() -> None:
+                try:
+                    self._drain_and_commit(t1, blocking=False)
+                except BaseException as e:  # re-raised at the next wait()
+                    self._commit_error = e
+
+            self._commit_thread = threading.Thread(
+                target=_bg, name="tpuic-ckpt-commit", daemon=True)
+            self._commit_thread.start()
 
     def save_best(self, state, epoch: int, best_score: float) -> None:
         """Reference train.py:173-180 — on val-accuracy improvement."""

@@ -173,6 +173,43 @@ class ModelConfig:
     # Stochastic depth for ViT backbones (rate of the LAST block; rates
     # ramp linearly from 0 — the DeiT schedule). CNNs ignore this.
     drop_path: float = 0.0
+    # Training compute-dtype POLICY ('' | 'bf16' | 'f32'), wired through
+    # ``train.py --compute-dtype``. '' (default) leaves the per-model
+    # ``dtype`` field in charge — bitwise the pre-policy behavior. 'bf16'
+    # is the mixed-precision training tier: the forward/backward run in
+    # bfloat16 (``dtype`` is forced, batch images are cast at the step
+    # entry) while the differentiated MASTER params stay float32
+    # (``param_dtype``), the optimizer moments stay float32 (optax init
+    # mirrors the f32 params), the loss is computed on f32 logits, and
+    # checkpoints stay float32 on disk — the lifecycle / hot-swap /
+    # elastic machinery never sees a dtype change. 'f32' forces full
+    # float32 compute: the convergence-parity reference arm
+    # (scripts/bf16_parity.py, the tier-1 "bf16 parity" CI gate).
+    compute_dtype: str = ""
+
+    def __post_init__(self):
+        resolve_compute_dtype(self)  # validate eagerly, not at trace time
+
+
+# Accepted spellings of the ModelConfig.compute_dtype policy -> canonical
+# tag. '' = legacy (per-model dtype field rules).
+_COMPUTE_DTYPES = {"": "", "bf16": "bf16", "bfloat16": "bf16",
+                   "f32": "f32", "float32": "f32"}
+
+
+def resolve_compute_dtype(model: "ModelConfig") -> str:
+    """Canonical compute-dtype tag for a ModelConfig: '', 'bf16' or 'f32'.
+
+    The single normalization point: the Trainer (model dtype override +
+    telemetry roofline choice) and the train step (batch cast, f32-loss
+    guarantee) must agree on what the policy means."""
+    key = str(getattr(model, "compute_dtype", "") or "").lower()
+    if key not in _COMPUTE_DTYPES:
+        raise ValueError(
+            f"unknown compute_dtype {model.compute_dtype!r}; expected one "
+            f"of {sorted(k for k in _COMPUTE_DTYPES if k)} (or '' for the "
+            "per-model dtype default)")
+    return _COMPUTE_DTYPES[key]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,6 +283,26 @@ class OptimConfig:
     # Use the fused Pallas cross-entropy kernel
     # (tpuic/kernels/cross_entropy.py) in the train step.
     fused_loss: bool = False
+    # Fused one-pass optimizer-update kernel for 'lars' / 'lamb'
+    # (tpuic/kernels/optimizer_update.py): params, grads and moments make
+    # ONE VMEM round trip per leaf instead of the optax chain's stacked
+    # elementwise HLOs (decay -> trust -> lr -> momentum each
+    # materializing an update-sized tree). Trajectory parity vs the
+    # optax chain and the numpy trust-ratio references is golden-pinned
+    # in tests/test_fused_optimizer.py; off-TPU the same math runs as a
+    # single fused jnp pass (graceful fallback — no Pallas required).
+    # NOTE: the fused opt_state layout differs from optax's chain state,
+    # so flipping this over an existing checkpoint restores through the
+    # lenient path (optimizer moments reset; params are untouched).
+    fused_optimizer: bool = False
+    # Static loss scaling for bf16 training (ModelConfig.compute_dtype):
+    # the step multiplies the loss by this factor before the backward
+    # pass and unscales the gradients after, lifting tiny gradients over
+    # bf16 underflow. 1.0 = off, the right default for the TPU-style
+    # bf16 recipe (f32 master weights, f32 grads out of the cast-site
+    # VJPs) — the knob exists for stress runs. An overflowed scaled step
+    # surfaces as non-finite grads and rides the skip_nonfinite guard.
+    loss_scale: float = 1.0
     # Non-finite step guard (docs/robustness.md): the train step checks
     # loss/grad-norm finiteness in-graph and applies the optimizer update
     # under lax.cond — a NaN/Inf batch leaves params, opt_state, EMA, BN
@@ -266,6 +323,10 @@ class OptimConfig:
                 f"random_erase is a PROBABILITY in [0, 1]; got "
                 f"{self.random_erase} (mixup/cutmix use alpha-style "
                 "knobs, this one does not)")
+        if not self.loss_scale > 0.0:
+            raise ValueError(
+                f"loss_scale must be > 0; got {self.loss_scale} "
+                "(1.0 disables scaling)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -359,6 +420,17 @@ class RunConfig:
     # error-budget burn rate ride the goodput log line, the 'slo' bus
     # events, and the Prometheus exposition. '' disables.
     slo: str = ""
+    # Async checkpoint commits (docs/robustness.md "Async checkpoint
+    # commits"): a save stages its write and returns; the manifest walk
+    # and the .new -> track rotation run on a background thread, so the
+    # goodput 'checkpoint' bucket measures ~0 instead of the blocking
+    # commit span. Deferred, never early — the track-level manifest that
+    # gang.committed_steps / fleet_resume_step read still appears only
+    # at rotation, so a rank can never advertise a commit the fleet
+    # cannot restore. Multi-host runs fall back to synchronous commits
+    # (the commit barrier is a collective and must stay on the main
+    # thread). False restores blocking commits everywhere.
+    async_checkpoint: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

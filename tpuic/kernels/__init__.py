@@ -9,6 +9,9 @@ from tpuic.kernels.conv_bn_relu import (fold_bn,  # noqa: F401
                                         fused_conv_bn_relu)
 from tpuic.kernels.cross_entropy import fused_weighted_cross_entropy  # noqa: F401
 from tpuic.kernels.flash_attention import flash_attention  # noqa: F401
+from tpuic.kernels.optimizer_update import (default_opt_impl,  # noqa: F401
+                                            lamb_leaf_update,
+                                            lars_leaf_update)
 
 
 def default_interpret() -> bool:

@@ -69,6 +69,12 @@ Registered points (site → meaning of ``step``):
                       router's breaker + in-flight failover path
                       (tpuic/serve/router.py, docs/serving.md "Replica
                       routing and failover").
+- ``bf16_master_truncate`` — train step TRACE time (train/step.py
+                      ``_apply_update``): bake a bf16 round-trip of the
+                      updated master weights into the compiled step — the
+                      classic no-f32-master mixed-precision bug. Drives
+                      the ``scripts/bf16_parity.py --expect-fail`` arm
+                      (the convergence-parity gate must catch it).
 - ``swap_corrupt``  — hot-swap admission gate (checkpoint/loading.py
                       ``load_candidate_variables``): corrupt the swap
                       CANDIDATE's staged bytes (one payload file,
@@ -151,7 +157,7 @@ REGISTERED_POINTS = frozenset({
     "nan_batch", "sigterm", "decode_error", "ckpt_kill", "hang_device",
     "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
     "rank_hang", "rank_rejoin_flap", "replica_crash", "replica_wedge",
-    "swap_corrupt", "canary_degrade",
+    "swap_corrupt", "canary_degrade", "bf16_master_truncate",
 })
 
 

@@ -317,6 +317,14 @@ class TensorBoardSink:
                        if k.startswith("frac_")}
             if "mfu" in d and d["mfu"] is not None:
                 scalars["mfu"] = float(d["mfu"])
+            if d.get("compute_dtype"):
+                # Info-style scalar (constant 1, dtype in the tag): TB
+                # has no string scalars, and runs compared side by side
+                # need the precision arm visible.
+                scalars[f"compute_dtype_{d['compute_dtype']}"] = 1.0
+            if d.get("checkpoint_async_s") is not None:
+                scalars["goodput_checkpoint_async_s"] = float(
+                    d["checkpoint_async_s"])
             if scalars:
                 self._tb.scalars(int(d.get("step", self._step)), **scalars)
         elif ev.kind == "restart":

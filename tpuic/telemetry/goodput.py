@@ -54,11 +54,28 @@ PEAK_FLOPS = {
     "cpu": 1e12,             # nominal, keeps the metric finite in CI
 }
 
+# f32 peak FLOP/s: TPU MXUs run f32 matmuls at half the bf16 rate
+# (spec-sheet convention — the same systolic array issues one f32 or
+# two bf16 MACs per cell per cycle).  An f32 run judged against the
+# bf16 roofline would under-report MFU by exactly 2x, which is how a
+# "bf16 doubled our MFU" claim lies: same math, different denominator.
+# The cpu entry stays nominal — CI only needs the metric finite.
+PEAK_FLOPS_F32 = {k: (v / 2.0 if k != "cpu" else v)
+                  for k, v in PEAK_FLOPS.items()}
 
-def peak_flops(device) -> float:
-    """Peak bf16 FLOP/s for a jax device (1e12 nominal fallback)."""
+_DTYPE_PEAKS = {"bf16": PEAK_FLOPS, "bfloat16": PEAK_FLOPS,
+                "f32": PEAK_FLOPS_F32, "float32": PEAK_FLOPS_F32}
+
+
+def peak_flops(device, dtype: str = "bf16") -> float:
+    """Peak FLOP/s for a jax device at ``dtype`` ('bf16' default, 'f32'
+    for the half-rate f32 roofline; 1e12 nominal fallback)."""
+    table = _DTYPE_PEAKS.get(str(dtype).lower())
+    if table is None:
+        raise ValueError(f"peak_flops: unknown dtype {dtype!r} "
+                         "(want 'bf16' or 'f32')")
     kind = getattr(device, "device_kind", "cpu") if device is not None else "cpu"
-    for k, v in PEAK_FLOPS.items():
+    for k, v in table.items():
         if str(kind).lower().startswith(k.lower()):
             return v
     return 1e12
@@ -165,34 +182,43 @@ def check_flops_drift(model_name: str, image_size: int, global_batch: int,
 
 
 # Analytic forward GFLOPs per image at a canonical resolution
-# (published per-model numbers; prefix-matched so '-s2d'/'-cifar'
-# variants inherit the family figure unless listed).  The training
-# step is fwd + bwd ~= 3x forward — exactly bench.py's historical
-# fallback formula (3 * 2 * 4.1e9 * B / 2 for resnet50@224), which
-# tests/test_telemetry.py pins as the golden value.
+# (2x the published per-model GMAC figures; prefix-matched so
+# '-s2d'/'-cifar' variants inherit the family figure unless listed).
+# The training step is fwd + bwd ~= 3x forward.
+#
+# Every entry is cross-checked against the compiler's own count by
+# tests/test_flops_zoo.py (forward-only compile at the canonical shape,
+# drift must stay under check_flops_drift's 10% warning threshold).
+# That sweep is what caught the table's original sin TWICE: the 0.56e9
+# resnet18-cifar entry (PR 10, 43% drift) and then the ENTIRE rest of
+# the zoo (PR 16) were literature GMAC counts pasted as FLOPs — 2x low
+# across the board, flattering-halving every analytic-table MFU number.
+# The vit-tiny entry was worse: the DeiT-Ti literature figure pasted
+# onto this repo's test-scale ViT (patch 4, hidden 64, depth 2), a
+# model with ~5x that cost at 224px (patch-4 token counts make the
+# quadratic attention term dominate); its entry is the compiled count.
 FWD_FLOPS_PER_IMAGE = {
-    # 1.11e9 = 2 * 0.56 GMACs: the CIFAR-ResNet18 literature figure is
-    # MACs, and the table is FLOPs (2 per MAC).  The original 0.56e9
-    # entry was the MAC count pasted as FLOPs — PR 10's
-    # check_flops_drift surfaced it as a 43% drift vs the compiler's
-    # count (compiled fwd ~1.04e9/img at 32px); at 1.11e9 the drift is
-    # ~7%, inside the 10% warning threshold the profile smoke asserts.
+    # 1.11e9 = 2 * 0.56 GMACs (CIFAR-ResNet18).  Fwd-only drift vs the
+    # compiler is ~15% (compiled fwd ~0.97e9/img at 32px) — the one
+    # entry tests/test_flops_zoo.py carries a documented wider bound
+    # for; the profile smoke's train-side drift stays ~7% because the
+    # compiled bwd runs ~2.7x fwd, absorbing the overshoot.
     "resnet18-cifar": (1.11e9, 32),
-    "resnet18": (1.82e9, 224),
-    "resnet34": (3.67e9, 224),
-    "resnet50": (4.1e9, 224),
-    "resnet101": (7.8e9, 224),
-    "resnet152": (11.5e9, 224),
-    "inceptionv3": (5.7e9, 299),
-    "efficientnet-b0": (0.39e9, 224),
-    "efficientnet-b3": (1.8e9, 300),
-    "efficientnet-b7": (37e9, 600),
-    "vit-tiny": (1.26e9, 224),
-    "vit-s16": (4.6e9, 224),
-    "vit-b16": (17.6e9, 224),
-    "vit-b32": (4.4e9, 224),
-    "vit-l16": (61.6e9, 224),
-    "vit-l32": (15.4e9, 224),
+    "resnet18": (3.64e9, 224),
+    "resnet34": (7.34e9, 224),
+    "resnet50": (8.2e9, 224),
+    "resnet101": (15.6e9, 224),
+    "resnet152": (23.0e9, 224),
+    "inceptionv3": (11.4e9, 299),
+    "efficientnet-b0": (0.78e9, 224),
+    "efficientnet-b3": (3.6e9, 300),
+    "efficientnet-b7": (74e9, 600),
+    "vit-tiny": (6.3e9, 224),
+    "vit-s16": (9.2e9, 224),
+    "vit-b16": (35.2e9, 224),
+    "vit-b32": (8.8e9, 224),
+    "vit-l16": (123.2e9, 224),
+    "vit-l32": (30.8e9, 224),
 }
 
 
@@ -235,10 +261,12 @@ class GoodputTracker:
     """
 
     def __init__(self, flops_per_step: Optional[float] = None,
-                 peak_flops: float = 1e12, global_batch: int = 0) -> None:
+                 peak_flops: float = 1e12, global_batch: int = 0,
+                 compute_dtype: str = "") -> None:
         self._lock = threading.Lock()
         self.flops_per_step = flops_per_step
         self.peak = max(1.0, float(peak_flops))
+        self.compute_dtype = str(compute_dtype)
         self.global_batch = int(global_batch)
         self._t0: Optional[float] = None
         self.buckets = {k: 0.0 for k in _BUCKETS}
@@ -248,6 +276,7 @@ class GoodputTracker:
         self.restarts = 0        # supervisor restart count of this run
         self._pending_compile = 0.0
         self._step_total_s = 0.0  # for the rolling mean (skip estimate)
+        self.ckpt_async_s = 0.0   # deferred commits (overlapped, not wall)
 
     # -- event intake --------------------------------------------------
     def start(self) -> None:
@@ -294,7 +323,16 @@ class GoodputTracker:
                 self.buckets["compile"] += c
                 self.buckets["productive"] += dur - c
             elif kind == "checkpoint_commit":
-                self.buckets["checkpoint"] += float(d.get("duration_s", 0.0))
+                # A deferred (async) commit ran concurrently with compute
+                # — it consumed no wall clock the step loop could have
+                # used, so charging it to the 'checkpoint' bucket would
+                # double-book seconds already in 'productive'.  Tracked
+                # separately so report() still shows the overlapped work.
+                if d.get("blocking", True):
+                    self.buckets["checkpoint"] += float(
+                        d.get("duration_s", 0.0))
+                else:
+                    self.ckpt_async_s += float(d.get("duration_s", 0.0))
             elif kind == "rollback":
                 self.buckets["rollback"] += float(d.get("duration_s", 0.0))
             elif kind == "restart":
@@ -362,6 +400,9 @@ class GoodputTracker:
             out["skipped_steps_est"] = round(self.skipped_est, 1)
             out["compiles"] = self.compiles
             out["restarts"] = self.restarts
+            out["checkpoint_async_s"] = round(self.ckpt_async_s, 3)
+            if self.compute_dtype:
+                out["compute_dtype"] = self.compute_dtype
             m = self.mfu(wall)
             if m is not None:
                 out["mfu"] = round(m, 4)

@@ -511,6 +511,17 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
             rows.append(("goodput_fraction", v, "gauge",
                          "fraction of wall time per goodput bucket",
                          {"bucket": k[5:]}))
+    if report.get("compute_dtype"):
+        # Info-style row (value 1, dtype as label): lets dashboards and
+        # alerts split MFU/step-time series by precision arm.
+        rows.append(("compute_dtype_info", 1, "gauge",
+                     "active train compute dtype",
+                     {"dtype": str(report["compute_dtype"])}))
+    rows.append(("checkpoint_async_seconds",
+                 report.get("checkpoint_async_s"), "gauge",
+                 "checkpoint commit work overlapped with compute (async "
+                 "commits; blocking stall is goodput_fraction "
+                 "bucket=checkpoint)", None))
     for src, name in ((steptime or {}).get("total_ms"), "step_total_ms"), \
                      ((steptime or {}).get("data_ms"), "step_data_wait_ms"):
         for q, v in (src or {}).items():

@@ -39,7 +39,7 @@ class TrainTelemetry:
 
     def __init__(self, run_cfg, *, model_name: str = "", image_size: int = 0,
                  global_batch: int = 0, n_devices: int = 1, device=None,
-                 tb=None) -> None:
+                 tb=None, compute_dtype: str = "") -> None:
         global _active
         if _active is not None:
             _active.close()
@@ -90,9 +90,14 @@ class TrainTelemetry:
         self._unsubs.append(bus.subscribe(self.memory.on_event,
                                           kinds=("step",)))
         flops = analytic_flops_per_step(model_name, image_size, global_batch)
-        peak = peak_flops(device) * max(1, int(n_devices))
+        # Dtype-aware roofline: an f32 run is judged against the f32 peak
+        # (half the bf16 MXU rate on TPU), so MFU compares honestly
+        # across --compute-dtype arms instead of flattering bf16 by 2x.
+        peak = peak_flops(device, compute_dtype or "bf16") * max(
+            1, int(n_devices))
         self.goodput = GoodputTracker(flops_per_step=flops, peak_flops=peak,
-                                      global_batch=global_batch)
+                                      global_batch=global_batch,
+                                      compute_dtype=compute_dtype)
         self._unsubs.append(bus.subscribe(self.goodput.on_event))
         # Step-time SLOs (telemetry/slo.py): attainment + error-budget
         # burn over the 'step' events the StepTimer already publishes —

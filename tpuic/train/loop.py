@@ -83,6 +83,19 @@ class Trainer:
         mcfg = cfg.model
         if num_classes != mcfg.num_classes:
             mcfg = dataclasses.replace(mcfg, num_classes=num_classes)
+        # Mixed-precision policy (docs/performance.md "Mixed-precision
+        # training"): compute_dtype is the one knob — it forces the flax
+        # forward dtype, the train step's batch cast and f32-loss guard
+        # (train/step.py), and the dtype-aware MFU roofline below. Master
+        # weights, optimizer moments, and checkpoints stay f32 regardless
+        # (param_dtype is untouched), so lifecycle/swap/elastic machinery
+        # never sees a bf16 artifact.
+        from tpuic.config import resolve_compute_dtype
+        compute_dtype = resolve_compute_dtype(mcfg)
+        if compute_dtype:
+            mcfg = dataclasses.replace(
+                mcfg, dtype=("bfloat16" if compute_dtype == "bf16"
+                             else "float32"))
         if cfg.optim.auto_class_weights:
             # Inverse-frequency CE weights from the train fold (what the
             # reference's hand-tuned [3,3,10,1,4,4,5] approximated for its
@@ -138,7 +151,8 @@ class Trainer:
         self._build_steps()
         self.last_misclassified: list = []
         self.ckpt = CheckpointManager(cfg.run.ckpt_dir, mcfg.name,
-                                      cfg.run.save_period)
+                                      cfg.run.save_period,
+                                      async_commit=cfg.run.async_checkpoint)
         if is_host0():
             # Reproducibility sidecar: the resolved config (incl. inferred
             # num_classes / derived class weights) next to the checkpoint
@@ -196,7 +210,9 @@ class Trainer:
         self.telemetry = _telemetry.TrainTelemetry(
             cfg.run, model_name=mcfg.name, image_size=d.resize_size,
             global_batch=global_batch, n_devices=self.mesh.size,
-            device=jax.devices()[0], tb=self.logger.tb)
+            device=jax.devices()[0], tb=self.logger.tb,
+            compute_dtype=(compute_dtype or (
+                "bf16" if mcfg.dtype == "bfloat16" else "f32")))
         if self.telemetry.profile is not None:
             # Device-time attribution (telemetry/profile.py): hand the
             # analyzer the REAL train step's AOT view. Called lazily

@@ -9,7 +9,10 @@ LARS covers BASELINE.md config 5 (large-batch ResNet-50).
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple, Optional
+
 import jax
+import jax.numpy as jnp
 import optax
 
 from tpuic.config import OptimConfig
@@ -70,6 +73,95 @@ def rewarm_scale(start_step: int, rewarm_steps: int):
     return scale
 
 
+class FusedLarsState(NamedTuple):
+    """count: updates applied (the schedule clock); trace: momentum."""
+    count: jnp.ndarray
+    trace: Any
+
+
+class FusedLambState(NamedTuple):
+    """count: updates applied (schedule + Adam debias clock); mu/nu: the
+    f32 Adam moments."""
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def _lr_at(learning_rate, count):
+    return (learning_rate(count) if callable(learning_rate)
+            else learning_rate)
+
+
+def fused_lars(learning_rate, weight_decay: float = 0.0,
+               trust_coefficient: float = 0.001, momentum: float = 0.9,
+               impl: Optional[str] = None) -> optax.GradientTransformation:
+    """optax.lars semantics as ONE fused pass per leaf
+    (tpuic/kernels/optimizer_update.py): update order wd -> trust -> -lr
+    -> momentum trace, trajectory-pinned against optax.lars in
+    tests/test_fused_optimizer.py. A real optax.GradientTransformation,
+    so grad-clip / freeze / MultiSteps wrappers compose unchanged."""
+    from tpuic.kernels.optimizer_update import lars_leaf_update
+
+    def init_fn(params):
+        return FusedLarsState(count=jnp.zeros([], jnp.int32),
+                              trace=jax.tree.map(jnp.zeros_like, params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_lars needs params (trust ratio + wd)")
+        lr = _lr_at(learning_rate, state.count)
+        with jax.named_scope("fused_lars"):
+            # The new trace IS the update (optax.trace applies momentum
+            # after lr scaling), so one tree pass yields both.
+            new_trace = jax.tree.map(
+                lambda g, w, m: lars_leaf_update(
+                    w, g, m, lr=lr, weight_decay=weight_decay,
+                    trust_coefficient=trust_coefficient,
+                    momentum=momentum, impl=impl),
+                updates, params, state.trace)
+        return new_trace, FusedLarsState(count=state.count + 1,
+                                         trace=new_trace)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def fused_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
+               eps: float = 1e-6, weight_decay: float = 0.0,
+               impl: Optional[str] = None) -> optax.GradientTransformation:
+    """optax.lamb semantics with the Adam-moment + decayed-direction pass
+    fused per leaf (tpuic/kernels/optimizer_update.py); the trust-ratio
+    norms and the -lr rescale are scalar epilogues XLA folds into the
+    apply-updates add. Trajectory-pinned against optax.lamb."""
+    from tpuic.kernels.optimizer_update import lamb_leaf_update
+
+    def init_fn(params):
+        zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return FusedLambState(count=jnp.zeros([], jnp.int32),
+                              mu=zeros(), nu=zeros())
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb needs params (trust ratio + wd)")
+        lr = _lr_at(learning_rate, state.count)
+        gs = jax.tree.leaves(updates)
+        treedef = jax.tree.structure(updates)
+        ws = jax.tree.leaves(params)
+        ms = jax.tree.leaves(state.mu)
+        vs = jax.tree.leaves(state.nu)
+        with jax.named_scope("fused_lamb"):
+            outs = [lamb_leaf_update(w, g, m, v, state.count, lr=lr, b1=b1,
+                                     b2=b2, eps=eps,
+                                     weight_decay=weight_decay, impl=impl)
+                    for g, w, m, v in zip(gs, ws, ms, vs)]
+        upd = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        mu = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        nu = jax.tree.unflatten(treedef, [o[2] for o in outs])
+        return upd, FusedLambState(count=state.count + 1, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
                    total_epochs: int = 100,
                    lr_scale=None,
@@ -104,17 +196,26 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int = 1,
         # are large relative to their weights can't blow up at
         # large-batch LRs. Golden-value-pinned against an independent
         # numpy reference in tests/test_optimizer.py.
-        tx = optax.lars(lr, weight_decay=cfg.weight_decay,
-                        trust_coefficient=cfg.lars_trust_coefficient,
-                        momentum=cfg.lars_momentum)
+        if cfg.fused_optimizer:
+            tx = fused_lars(lr, weight_decay=cfg.weight_decay,
+                            trust_coefficient=cfg.lars_trust_coefficient,
+                            momentum=cfg.lars_momentum)
+        else:
+            tx = optax.lars(lr, weight_decay=cfg.weight_decay,
+                            trust_coefficient=cfg.lars_trust_coefficient,
+                            momentum=cfg.lars_momentum)
     elif name == "lamb":
         # LAMB (You et al., arXiv:1904.00962): the Adam-flavored sibling
         # — Adam moments first, then the per-layer trust ratio
         # ||w|| / ||adam_update + wd * w|| rescales each layer's step.
         # The large-batch recipe for attention models (ViT) where plain
         # LARS underperforms; golden-pinned next to LARS.
-        tx = optax.lamb(lr, b1=cfg.lamb_b1, b2=cfg.lamb_b2,
-                        eps=cfg.lamb_eps, weight_decay=cfg.weight_decay)
+        if cfg.fused_optimizer:
+            tx = fused_lamb(lr, b1=cfg.lamb_b1, b2=cfg.lamb_b2,
+                            eps=cfg.lamb_eps, weight_decay=cfg.weight_decay)
+        else:
+            tx = optax.lamb(lr, b1=cfg.lamb_b1, b2=cfg.lamb_b2,
+                            eps=cfg.lamb_eps, weight_decay=cfg.weight_decay)
     elif name == "sgd":
         tx = optax.sgd(lr, momentum=0.9)
         if cfg.weight_decay:

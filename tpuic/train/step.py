@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpuic.config import ModelConfig, OptimConfig
+from tpuic.config import ModelConfig, OptimConfig, resolve_compute_dtype
 from tpuic.metrics.meters import accuracy, topk_accuracy
 from tpuic.train.loss import classification_loss
 from tpuic.train.state import TrainState
@@ -155,6 +155,15 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     aux_w = model_cfg.aux_loss_weight
     smoothing = optim_cfg.label_smoothing
     remat_policy = resolve_remat_policy(model_cfg)
+    # Mixed-precision policy (ModelConfig.compute_dtype): under 'bf16' the
+    # batch is cast once at the step entry and the loss is computed on f32
+    # logits; the Trainer has already forced the model's compute dtype.
+    # The differentiated params stay f32 (param_dtype) — the in-module
+    # casts' VJPs accumulate f32 grads — so master weights, moments, and
+    # checkpoints never leave f32.
+    compute_dtype = resolve_compute_dtype(model_cfg)
+    cast_dtype = jnp.bfloat16 if compute_dtype == "bf16" else None
+    loss_scale = float(optim_cfg.loss_scale or 1.0)
     if (donate and optim_cfg.skip_nonfinite
             and getattr(jax.config, "jax_compilation_cache_dir", None)
             and jax.default_backend() == "cpu"):
@@ -171,7 +180,12 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
         warnings.warn(
             "skip_nonfinite guard + persistent compilation cache: "
             "disabling train-state donation to avoid a known "
-            "aliasing bug in cache-deserialized executables",
+            "aliasing bug in cache-deserialized executables "
+            "(independent of ModelConfig.compute_dtype / "
+            "--compute-dtype: the bf16 tier's cast sites produce fresh "
+            "arrays, never aliases of the donated state — set "
+            "skip_nonfinite=False or drop the cache dir to keep "
+            "donation)",
             stacklevel=2)
         donate = False
 
@@ -294,6 +308,16 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                 images = jnp.where(box[..., None],
                                    jnp.zeros_like(images), images)
 
+        if cast_dtype is not None:
+            # bf16 compute tier: activations enter the network in bf16.
+            # One cast of the batch — downstream params are cast inside
+            # the flax modules (dtype=bfloat16) and its VJP accumulates
+            # the gradient back in f32. After the augment block on
+            # purpose: mixup/cutmix blend in f32 and random-erase masks
+            # in the input dtype, identical to the f32 arm.
+            with jax.named_scope("cast_bf16"):
+                images = images.astype(cast_dtype)
+
         def forward(params, batch_stats, images, rng):
             variables = {"params": params, "batch_stats": batch_stats}
             # 'intermediates' carries sown MoE load-balancing losses
@@ -316,6 +340,11 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
                               params["backbone"])}
             out, mutated = forward(params, state.batch_stats, images,
                                    dropout_rng)
+            if cast_dtype is not None:
+                # f32-loss guarantee of the bf16 tier: log-softmax over
+                # bf16 logits costs ~3 decimal digits right where the
+                # parity gate measures.
+                out = jax.tree.map(lambda t: t.astype(jnp.float32), out)
             # 'loss' scope: CE (+aux) ops separate from the backbone's
             # layers in the device-time waterfall (telemetry/profile.py).
             with jax.named_scope("loss"):
@@ -343,14 +372,40 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
             logits = out[0] if isinstance(out, tuple) else out
             return loss, (mutated.get("batch_stats", state.batch_stats), logits)
 
-        (loss, (new_stats, logits)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        if loss_scale != 1.0:
+            # Static loss scaling (OptimConfig.loss_scale): backward runs
+            # on the scaled loss, then both are unscaled — numerically a
+            # no-op in exact arithmetic; in bf16 it lifts tiny cotangents
+            # over underflow. Overflow => non-finite grads => the skip
+            # guard below drops the step.
+            def scaled_loss_fn(params):
+                loss, aux = loss_fn(params)
+                return loss * loss_scale, aux
+            (loss, (new_stats, logits)), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True)(state.params)
+            inv = 1.0 / loss_scale
+            loss = loss * inv
+            grads = jax.tree.map(lambda g: g * inv, grads)
+        else:
+            (loss, (new_stats, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         grad_norm = optax.global_norm(grads)
 
         @jax.named_scope("optimizer_update")
         def _apply_update(st: TrainState) -> TrainState:
             new_state = st.apply_gradients(grads=grads).replace(
                 batch_stats=new_stats)
+            from tpuic.runtime import faults as _faults
+            if _faults.fire("bf16_master_truncate"):
+                # Seeded mixed-precision bug (trace-time inject, baked
+                # into the compiled step): master weights round-trip
+                # through bf16 every update — exactly the no-f32-master
+                # mistake the scripts/bf16_parity.py convergence gate
+                # exists to catch. Never armed outside the gate's
+                # --expect-fail arm.
+                new_state = new_state.replace(params=jax.tree.map(
+                    lambda p: p.astype(jnp.bfloat16).astype(p.dtype),
+                    new_state.params))
             if optim_cfg.ema_decay > 0 and st.ema_params is not None:
                 d = optim_cfg.ema_decay
                 new_ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p,

@@ -137,6 +137,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "the [C,C] confusion matrix beside metrics.jsonl")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--compute-dtype", default="", dest="compute_dtype",
+                   choices=["", "bf16", "f32"],
+                   help="training compute-dtype policy: 'bf16' runs "
+                        "forward/backward in bfloat16 with f32 master "
+                        "weights, f32 optimizer moments and f32 "
+                        "checkpoints (the mixed-precision tier, parity-"
+                        "gated in CI); 'f32' forces full float32 (the "
+                        "parity reference arm); '' defers to --dtype")
+    p.add_argument("--loss-scale", type=float, default=1.0,
+                   help="static loss scaling for --compute-dtype bf16 "
+                        "(loss x N before backward, grads / N after; "
+                        "1.0 = off — bf16 with f32 master weights "
+                        "rarely needs it; overflow rides the skip "
+                        "guard)")
+    p.add_argument("--fused-optimizer", action="store_true",
+                   help="use the fused one-pass Pallas optimizer-update "
+                        "kernel for lars/lamb "
+                        "(tpuic/kernels/optimizer_update.py; jnp "
+                        "fallback off-TPU)")
+    p.add_argument("--no-async-checkpoint", action="store_true",
+                   help="commit checkpoints synchronously (block the "
+                        "step timeline on manifest + rotation) instead "
+                        "of on the background commit thread")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--model-axis", type=int, default=1,
                    help="mesh model-axis size (1 = pure data parallel; >1 = "
@@ -272,7 +295,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           dtype=args.dtype, attention=args.attention,
                           remat=args.remat, remat_policy=args.remat_policy,
                           drop_path=args.drop_path,
-                          bn_f32_stats=not args.bn_bf16_stats),
+                          bn_f32_stats=not args.bn_bf16_stats,
+                          compute_dtype=args.compute_dtype),
         optim=OptimConfig(optimizer=args.optimizer, learning_rate=args.lr,
                           milestones=tuple(args.milestones), gamma=args.gamma,
                           class_weights=weights,
@@ -289,6 +313,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           ema_decay=args.ema_decay,
                           freeze_backbone=args.freeze_backbone,
                           fused_loss=args.fused_loss,
+                          fused_optimizer=args.fused_optimizer,
+                          loss_scale=args.loss_scale,
                           skip_nonfinite=not args.no_skip_guard),
         run=RunConfig(epochs=args.epochs, ckpt_dir=args.ckpt_dir,
                       save_period=args.save_period, resume=not args.no_resume,
@@ -306,7 +332,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                       trace_threshold=args.trace_threshold,
                       trace_steps=args.trace_steps,
                       trace_analyze=args.trace_analyze,
-                      slo=args.slo),
+                      slo=args.slo,
+                      async_checkpoint=not args.no_async_checkpoint),
         mesh=MeshConfig(model=args.model_axis, seq=args.seq_axis,
                         fsdp=args.fsdp, zero1=args.zero1),
     )
