@@ -134,6 +134,78 @@ def test_pack_roundtrip_and_reuse(tree, tmp_path):
     assert os.path.getmtime(rebuilt.bin_path) != mtime
 
 
+def test_pack_row_crc_detects_bin_bitrot(tree, tmp_path):
+    """v2 packs carry per-row CRC32s: flipping bytes inside ONE row of
+    the .bin (silent at-rest rot — size unchanged, fingerprint covers
+    only the SOURCE files) fails verify_row for exactly that row."""
+    from tpuic.runtime import faults
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    ds = ImageFolderDataset(tree, "val", 32, cfg)
+    packed = pack_dataset(ds, str(tmp_path / "cache"), verbose=False)
+    n = len(packed)
+    assert all(packed.verify_row(i) for i in range(n))
+    assert all(packed.row_crc32(i) is not None for i in range(n))
+    row = 32 * 32 * 3
+    victim = 2
+    faults.corrupt_file(packed.bin_path, offset=victim * row + 11, nbytes=8)
+    # Fresh mmap so the reread sees the rotted bytes, reuse path intact.
+    reread = pack_dataset(ImageFolderDataset(tree, "val", 32, cfg),
+                          str(tmp_path / "cache"), verbose=False)
+    assert os.path.getmtime(reread.bin_path) \
+        == os.path.getmtime(packed.bin_path)  # cache hit, no rebuild
+    bad = [i for i in range(n) if not reread.verify_row(i)]
+    assert bad == [victim]
+
+
+def test_pack_version_bump_invalidates_v1_meta(tree, tmp_path):
+    """A pre-v2 meta (no row CRCs) must not be reused as-is: the version
+    check rebuilds it into a v2 pack, while a hand-loaded v1 meta stays
+    readable and verifies as trusted-unverifiable (True)."""
+    import json
+    from tpuic.data.pack import PackedDataset, _PACK_VERSION
+    cfg = DataConfig(data_dir=tree, resize_size=32)
+    ds = ImageFolderDataset(tree, "val", 32, cfg)
+    cache = str(tmp_path / "cache")
+    packed = pack_dataset(ds, cache, verbose=False)
+    meta_path = packed.bin_path[:-len(".bin")] + ".json"
+    meta = json.load(open(meta_path))
+    assert meta["version"] == _PACK_VERSION >= 2
+    # Downgrade the meta to the v1 shape a pre-upgrade run left behind.
+    v1 = dict(meta, version=1)
+    v1.pop("row_crc32")
+    json.dump(v1, open(meta_path, "w"))
+    old = PackedDataset(packed.bin_path, v1, train=False, cfg=cfg)
+    assert old.row_crc32(0) is None
+    assert old.verify_row(0)  # absence of evidence is not a quarantine
+    rebuilt = pack_dataset(ImageFolderDataset(tree, "val", 32, cfg), cache,
+                           verbose=False)
+    assert json.load(open(meta_path))["version"] == _PACK_VERSION
+    assert rebuilt.row_crc32(0) is not None
+
+
+def test_pack_quarantines_corrupt_source_with_honest_accounting(
+        tree, tmp_path):
+    """Pack-time quarantine on the packed path: one truncated source
+    file in the corpus packs a same-class replacement row — with the
+    replacement's label, id, AND row CRC — and the event is counted."""
+    import shutil
+    from tpuic.runtime import faults
+    root = str(tmp_path / "rotted")
+    shutil.copytree(tree, root)
+    cfg = DataConfig(data_dir=root, resize_size=32, quarantine_retries=0,
+                     quarantine_backoff_s=0.0)
+    ds = ImageFolderDataset(root, "val", 32, cfg)
+    victim_path, victim_label = ds.samples[1]
+    faults.truncate_file(victim_path, keep=8)
+    packed = pack_dataset(ds, str(tmp_path / "cache"), verbose=False)
+    assert packed.quarantine_count == 1
+    # The replacement row is honest: its id is a real same-class sample's
+    # (not the victim's), its label matches, and its CRC verifies.
+    assert packed.image_id(1) != ds.image_id(1)
+    assert packed.label(1) == int(victim_label)
+    assert all(packed.verify_row(i) for i in range(len(packed)))
+
+
 # -- device-side augmentation ----------------------------------------------
 
 def test_device_prep_matches_numpy_all_paths():

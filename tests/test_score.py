@@ -1,0 +1,450 @@
+"""Elastic bulk scoring (tpuic/score/): leases, exactly-once commits,
+resume, quarantine accounting, and the fleet ledger audit.
+
+The subsystem's contract (docs/robustness.md, "Bulk scoring"): a SIGKILL
+anywhere resumes without re-scoring a committed shard and without
+dropping an uncommitted one; scored + quarantined == corpus, per shard
+and in total; duplicates loud; zero steady-state compiles."""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpuic.runtime import faults
+from tpuic.score.commit import ShardStore, result_line
+from tpuic.score.work import (LeaseDir, corpus_token, plan_shards,
+                              write_or_verify_plan)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def stub_forward():
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(variables, images):
+        s = jnp.sum(images.astype(jnp.float32), axis=(1, 2, 3))
+        probs = jax.nn.softmax(
+            jnp.stack([s, -s, jnp.zeros_like(s)], axis=-1) / 1000.0,
+            axis=-1)
+        return probs, jnp.argsort(-probs, axis=-1)
+    return fwd
+
+
+def _run(data, out, *, stub, **kw):
+    from tpuic.score.driver import run_score
+    kw.setdefault("resize", 16)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("shard_size", 5)
+    kw.setdefault("dtype", "fp32")
+    kw.setdefault("poll_s", 0.02)
+    return run_score(data_dir=data, out_dir=out, _forward=stub, **kw)
+
+
+@pytest.fixture()
+def corpus(tmp_path_factory):
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    root = tmp_path_factory.mktemp("score_corpus")
+    make_synthetic_imagefolder(str(root), classes=("a", "b", "c"),
+                               per_class=4, size=16)
+    return str(root)
+
+
+def _ledger(out):
+    from tpuic.telemetry.events import read_jsonl
+    recs = []
+    for p in sorted(glob.glob(os.path.join(out, "*.jsonl"))):
+        recs.extend(read_jsonl(p))
+    return recs
+
+
+def _audit(out):
+    from tpuic.telemetry.fleet import load_streams, score_audit
+    return score_audit(load_streams([out]))
+
+
+# -- plan --------------------------------------------------------------------
+def test_plan_shards_math():
+    assert plan_shards(12, 5) == [(0, 5), (5, 10), (10, 12)]
+    assert plan_shards(4, 5) == [(0, 4)]
+    assert plan_shards(10, 5) == [(0, 5), (5, 10)]
+    with pytest.raises(ValueError):
+        plan_shards(0, 5)
+    with pytest.raises(ValueError):
+        plan_shards(5, 0)
+
+
+def test_plan_file_first_wins_and_mismatch_is_loud(tmp_path):
+    w = str(tmp_path)
+    tok = corpus_token(12, 16, [f"id{i}" for i in range(12)])
+    plan, created = write_or_verify_plan(w, n=12, shard_size=5, token=tok,
+                                         dtype="fp32")
+    assert created and len(plan["shards"]) == 3
+    plan2, created2 = write_or_verify_plan(w, n=12, shard_size=5,
+                                           token=tok, dtype="fp32")
+    assert not created2 and plan2 == plan
+    # A different corpus/geometry/dtype into the same workdir must fail
+    # loudly, not interleave two jobs' shards.
+    for kw in ({"n": 13}, {"shard_size": 4}, {"token": tok + 1},
+               {"dtype": "int8"}):
+        full = {"n": 12, "shard_size": 5, "token": tok, "dtype": "fp32",
+                **kw}
+        with pytest.raises(ValueError, match="plan mismatch"):
+            write_or_verify_plan(w, **full)
+
+
+# -- leases ------------------------------------------------------------------
+def test_lease_acquire_is_exclusive_then_released(tmp_path):
+    a = LeaseDir(str(tmp_path), rank=0, ttl_s=30.0)
+    b = LeaseDir(str(tmp_path), rank=1, ttl_s=30.0)
+    assert a.acquire(3)
+    assert not b.acquire(3)          # live lease: no steal
+    assert a.renew(3)
+    assert not b.renew(3)            # not the owner
+    a.release(3)
+    assert b.acquire(3)              # freed: plain O_EXCL reacquire
+    b.release(3)
+
+
+def test_lease_ttl_expiry_steal_and_token_confirm(tmp_path):
+    a = LeaseDir(str(tmp_path), rank=0, ttl_s=0.5)
+    b = LeaseDir(str(tmp_path), rank=1, ttl_s=0.5)
+    assert a.acquire(0)
+    # Age the lease past its declared TTL without sleeping.
+    past = os.stat(a.path(0)).st_mtime - 5.0
+    os.utime(a.path(0), (past, past))
+    assert b.acquire(0)              # stolen
+    assert b.steals == 1
+    assert not a.renew(0)            # the old owner must notice
+    rec = b.owner(0)
+    assert rec["rank"] == 1 and rec["token"] == b.token
+
+
+def test_lease_membership_orphan_steals_without_waiting_ttl(tmp_path):
+    a = LeaseDir(str(tmp_path), rank=1, ttl_s=3600.0)
+    b = LeaseDir(str(tmp_path), rank=0, ttl_s=3600.0)
+    assert a.acquire(2)
+    # Rank 1 fell out of the active set: its fresh, hour-long lease is
+    # orphaned NOW — the membership-accelerated steal.
+    assert not b.acquire(2, active=[0, 1])
+    assert b.acquire(2, active=[0])
+    assert b.owner(2)["rank"] == 0
+
+
+def test_lease_skew_fault_forces_expiry(tmp_path):
+    a = LeaseDir(str(tmp_path), rank=0, ttl_s=3600.0)
+    b = LeaseDir(str(tmp_path), rank=1, ttl_s=3600.0)
+    assert a.acquire(7)
+    faults.arm("lease_skew")         # default payload: one full TTL
+    assert b.acquire(7)              # live lease read as expired
+    assert faults.fired("lease_skew") >= 1
+
+
+# -- commits -----------------------------------------------------------------
+def _lines(lo, hi):
+    return [result_line({"index": i, "id": f"id{i}", "label": 0,
+                         "pred": 1, "prob": "0.900000"})
+            for i in range(lo, hi)]
+
+
+def test_commit_link_arbitration_is_exactly_once(tmp_path):
+    a = ShardStore(str(tmp_path), rank=0)
+    b = ShardStore(str(tmp_path), rank=1)
+    lines = _lines(0, 5)
+    va, _ = a.commit(0, 0, 5, lines, scored=5, quarantined=0)
+    vb, man = b.commit(0, 0, 5, lines, scored=5, quarantined=0)
+    assert (va, vb) == ("committed", "duplicate")
+    assert a.commits == 1 and b.commits == 0 and b.duplicates == 1
+    assert a.state(0) == "committed"
+    assert man["rank"] == 0 and not man["adopted"]  # the winner's manifest
+    assert open(a.result_path(0)).read() == "".join(lines)
+
+
+def test_commit_crash_window_orphan_is_adopted_not_rescored(tmp_path):
+    a = ShardStore(str(tmp_path), rank=0)
+    a.commit(1, 5, 10, _lines(5, 10), scored=5, quarantined=0)
+    # Simulate death between link and manifest (the scorer_crash
+    # window): the published result survives, the manifest does not.
+    os.unlink(a.manifest_path(1))
+    b = ShardStore(str(tmp_path), rank=1)
+    assert b.state(1) == "orphan"
+    man = b.adopt(1, 5, 10, scored=5, quarantined=0)
+    assert man["adopted"] and man["rank"] == 1
+    assert b.state(1) == "committed"
+
+
+def test_commit_duplicate_finishes_a_dead_winners_manifest(tmp_path):
+    a = ShardStore(str(tmp_path), rank=0)
+    a.commit(2, 0, 5, _lines(0, 5), scored=5, quarantined=0)
+    os.unlink(a.manifest_path(2))    # winner died in the window
+    b = ShardStore(str(tmp_path), rank=1)
+    verdict, man = b.commit(2, 0, 5, _lines(0, 5), scored=5,
+                            quarantined=0)
+    assert verdict == "duplicate" and man["adopted"]
+    assert b.state(2) == "committed"
+
+
+def test_commit_detects_atrest_bitrot_and_discards(tmp_path):
+    s = ShardStore(str(tmp_path), rank=0)
+    s.commit(3, 0, 5, _lines(0, 5), scored=5, quarantined=0)
+    assert s.state(3) == "committed"
+    faults.corrupt_file(s.result_path(3), offset=4, nbytes=4)
+    assert s.state(3) == "corrupt"   # manifest disagrees with the bytes
+    s.discard(3)
+    assert s.state(3) == "missing"   # back in the queue
+
+
+def test_scorer_crash_fires_in_spec_grammar():
+    plan = faults.FaultPlan("scorer_crash@1#1,shard_corrupt@2#1,"
+                            "lease_skew#120")
+    assert plan.fire("scorer_crash", step=1)
+    assert not plan.fire("scorer_crash", step=2)
+    assert plan.param("scorer_crash") == 1.0
+    assert plan.fire("shard_corrupt", step=2)
+    assert plan.param("lease_skew") == 120.0
+
+
+# -- driver ------------------------------------------------------------------
+def test_driver_single_rank_exact_ledger_zero_steady_compiles(
+        corpus, tmp_path, stub_forward):
+    out = str(tmp_path / "out")
+    s = _run(corpus, out, stub=stub_forward)
+    assert s["shards_committed"] == s["shards"] == 3
+    assert s["rows_scored"] == s["n"] == 12
+    assert s["rows_quarantined"] == 0
+    assert s["steady_compiles"] == 0
+    rep = _audit(out)
+    assert rep["ok"], rep["errors"]
+    kinds = [r["event"] for r in _ledger(out)]
+    assert kinds.count("score_plan") == 1
+    assert kinds.count("score_commit") == 3
+    assert kinds.count("score_done") == 1
+
+
+def test_driver_resumes_without_rescoring_committed_shards(
+        corpus, tmp_path, stub_forward):
+    base = str(tmp_path / "base")
+    _run(corpus, base, stub=stub_forward)
+
+    out = str(tmp_path / "out")
+    s1 = _run(corpus, out, stub=stub_forward, max_commits=1)
+    assert s1["halted"] and s1["commits_this_life"] == 1
+    s2 = _run(corpus, out, stub=stub_forward)
+    # The committed shard is NOT rescored: the second life only scores
+    # the remainder.
+    assert s2["commits_this_life"] == s2["shards"] - 1
+    assert s2["shards_committed"] == s2["shards"]
+    rep = _audit(out)
+    assert rep["ok"], rep["errors"]
+    # Bitwise: the interrupted-and-resumed job's shard files equal the
+    # undisturbed baseline's.
+    for i in range(s2["shards"]):
+        name = f"results/shard-{i:05d}.jsonl"
+        assert (open(os.path.join(out, name), "rb").read()
+                == open(os.path.join(base, name), "rb").read())
+
+
+def test_driver_two_ranks_share_the_queue_exactly_once(
+        corpus, tmp_path, stub_forward):
+    out = str(tmp_path / "out")
+    results = {}
+
+    def worker(rank):
+        results[rank] = _run(corpus, out, stub=stub_forward, rank=rank,
+                             ranks=2, shard_size=3)
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = _audit(out)
+    assert rep["ok"], rep["errors"]
+    assert rep["shards_committed"] == 4  # 12 rows / shard_size 3
+    assert rep["rows_scored"] == 12 and rep["shards_duplicated"] == 0
+    total = sum(r["commits_this_life"] + r["duplicates_this_life"]
+                for r in results.values())
+    assert total >= 4
+    # Per-rank streams exist and are attributable.
+    assert os.path.exists(os.path.join(out, "ledger.jsonl"))
+    assert os.path.exists(os.path.join(out, "ledger.rank1.jsonl"))
+
+
+def test_driver_shard_corrupt_fault_lands_in_quarantined_column(
+        corpus, tmp_path, stub_forward):
+    out = str(tmp_path / "out")
+    faults.arm("shard_corrupt", steps=1, param=2)  # shard 1, row lo+2
+    s = _run(corpus, out, stub=stub_forward)
+    assert s["rows_quarantined"] == 1
+    assert s["rows_scored"] == s["n"] - 1
+    rep = _audit(out)
+    assert rep["ok"], rep["errors"]          # quarantine keeps it exact
+    assert rep["rows_quarantined"] == 1
+    commit = [r for r in _ledger(out) if r["event"] == "score_commit"
+              and r["shard"] == 1][0]
+    assert commit["quarantined"] == 1        # the ledger's column
+    from tpuic.telemetry.events import read_jsonl
+    rows = read_jsonl(os.path.join(out, "results", "shard-00001.jsonl"))
+    bad = [r for r in rows if r.get("quarantined")]
+    assert len(bad) == 1 and bad[0]["index"] == 7  # shard 1 lo=5, +2
+    assert bad[0]["reason"] == "injected"
+
+
+def test_driver_packed_bitrot_row_quarantined_corpus_still_exact(
+        tmp_path, stub_forward):
+    # A corrupt record INSIDE the packed corpus (at-rest .bin rot): the
+    # row-CRC check quarantines it into the ledger's column and
+    # scored + quarantined == corpus still holds.
+    from tpuic.data.synthetic import make_synthetic_imagefolder
+    data = str(tmp_path / "data")
+    make_synthetic_imagefolder(data, classes=("a", "b"), per_class=4,
+                               size=16)
+    out1 = str(tmp_path / "clean")
+    _run(data, out1, stub=stub_forward)      # builds the pack cache
+    [bin_path] = glob.glob(os.path.join(data, ".tpuic_pack",
+                                        "pack-val-16.bin"))
+    row = 16 * 16 * 3
+    faults.corrupt_file(bin_path, offset=3 * row + 7, nbytes=16)
+    out2 = str(tmp_path / "rotted")
+    s = _run(data, out2, stub=stub_forward)
+    assert s["rows_quarantined"] == 1
+    rep = _audit(out2)
+    assert rep["ok"], rep["errors"]
+    assert rep["rows_quarantined"] == 1
+    from tpuic.telemetry.events import read_jsonl
+    rows = read_jsonl(os.path.join(out2, "results", "shard-00000.jsonl"))
+    bad = [r for r in rows if r.get("quarantined")]
+    assert len(bad) == 1 and bad[0]["index"] == 3
+    assert bad[0]["reason"] == "row_crc"
+
+
+def test_driver_rescores_a_rotted_result_file(corpus, tmp_path,
+                                              stub_forward):
+    out = str(tmp_path / "out")
+    _run(corpus, out, stub=stub_forward)
+    victim = os.path.join(out, "results", "shard-00002.jsonl")
+    before = open(victim, "rb").read()
+    faults.corrupt_file(victim, offset=8, nbytes=8)
+    s = _run(corpus, out, stub=stub_forward)  # resume pass
+    assert s["commits_this_life"] == 1        # only the rotted shard
+    assert open(victim, "rb").read() == before
+    rep = _audit(out)
+    # The rescore appends a SECOND score_commit for that shard — the
+    # audit must surface it loudly rather than double-count silently.
+    assert not rep["ok"]
+    assert rep["shards_duplicated"] == 1
+
+
+# -- ledger audit (bidirectional) -------------------------------------------
+def test_score_ledger_cli_passes_clean_and_fails_tampered(
+        corpus, tmp_path, stub_forward, capsys):
+    from tpuic.telemetry.fleet import main as fleet_main
+    out = str(tmp_path / "out")
+    _run(corpus, out, stub=stub_forward)
+    rep_json = str(tmp_path / "audit.json")
+    prom = str(tmp_path / "score.prom")
+    assert fleet_main([out, "--score-ledger", "--json", rep_json,
+                       "--prom-dump", prom]) == 0
+    assert json.load(open(rep_json))["ok"]
+    text = open(prom).read()
+    assert "tpuic_score_rows_scored 12" in text
+    assert "tpuic_score_ledger_exact 1" in text
+    capsys.readouterr()
+
+    ledger = os.path.join(out, "ledger.jsonl")
+    lines = open(ledger).read().splitlines(keepends=True)
+    commits = [ln for ln in lines if '"score_commit"' in ln]
+
+    # Duplicate commit record -> double-counted corpus, exit 1.
+    open(ledger, "a").write(commits[0])
+    assert fleet_main([out, "--score-ledger"]) == 1
+    err = capsys.readouterr().err
+    assert "committed 2 times" in err
+
+    # Dropped commit record -> missing shard, exit 1.
+    open(ledger, "w").writelines(ln for ln in lines if ln != commits[0])
+    assert fleet_main([out, "--score-ledger"]) == 1
+    err = capsys.readouterr().err
+    assert "NO commit record" in err
+
+
+def test_score_audit_counts_mismatch_and_foreign_shards():
+    from tpuic.telemetry.fleet import score_audit
+    plan = {"event": "score_plan", "n": 10, "shards": 2, "shard_size": 5,
+            "corpus_token": 1, "dtype": "fp32",
+            "shard_table": [[0, 5], [5, 10]]}
+
+    def commit(shard, scored, quar):
+        return {"event": "score_commit", "shard": shard, "scored": scored,
+                "quarantined": quar}
+    good = score_audit({0: [plan, commit(0, 5, 0), commit(1, 4, 1)]})
+    assert good["ok"] and good["rows_quarantined"] == 1
+    short = score_audit({0: [plan, commit(0, 5, 0), commit(1, 3, 1)]})
+    assert not short["ok"]
+    assert any("shard 1" in e for e in short["errors"])
+    foreign = score_audit({0: [plan, commit(0, 5, 0), commit(1, 5, 0),
+                               commit(7, 5, 0)]})
+    assert not foreign["ok"]
+    assert any("never defined" in e for e in foreign["errors"])
+    no_plan = score_audit({0: [commit(0, 5, 0)]})
+    assert not no_plan["ok"]
+
+
+def test_score_event_kinds_and_fault_points_registered():
+    from tpuic.telemetry.events import EVENT_KINDS
+    for kind in ("score_plan", "score_shard", "score_commit",
+                 "score_duplicate", "score_done"):
+        assert kind in EVENT_KINDS
+    for point in ("scorer_crash", "shard_corrupt", "lease_skew"):
+        assert point in faults.REGISTERED_POINTS
+
+
+# -- regress: environment_mismatch typed verdict -----------------------------
+def test_regress_environment_mismatch_exit3_distinct_from_regression():
+    from tpuic.telemetry.regress import CAL_CLAMP, compare, verdict_exit
+    baseline = {"calibration_s": 0.01, "metrics": {
+        "train.step_p50_ms": {"value": 100.0, "noise": 0.05}}}
+    specs = {"train.step_p50_ms": ("lower", "time", 0.5)}
+
+    # Comparable host: no mismatch, classic exits.
+    ok = compare(baseline, {"train.step_p50_ms": 100.0}, 0.02, specs=specs)
+    assert "environment_mismatch" not in ok
+    assert verdict_exit(ok) == 0
+    bad = compare(baseline, {"train.step_p50_ms": 1e5}, 0.02, specs=specs)
+    assert verdict_exit(bad) == 2 and verdict_exit(bad, True) == 0
+
+    # 6x-slower host (the PR-16 A/B shape): typed verdict, exit 3,
+    # overriding --expect-fail in BOTH directions.
+    slow = compare(baseline, {"train.step_p50_ms": 600.0}, 0.06,
+                   specs=specs)
+    em = slow["environment_mismatch"]
+    assert em["scale"] == 6.0 and em["clamp"] == CAL_CLAMP
+    assert slow["scale"] == CAL_CLAMP  # rows still computed, clamped
+    assert verdict_exit(slow) == 3
+    assert verdict_exit(slow, expect_fail=True) == 3
+    fast = compare(baseline, {"train.step_p50_ms": 20.0}, 0.002,
+                   specs=specs)
+    assert verdict_exit(fast) == 3
+    assert fast["environment_mismatch"]["scale"] == 0.2
+
+
+def test_prom_score_rows_from_done_summary():
+    from tpuic.telemetry.prom import render, score_rows
+    text = render(score_rows({"n": 48, "shards": 12,
+                              "shards_committed": 12, "rows_scored": 47,
+                              "rows_quarantined": 1,
+                              "steady_compiles": 0,
+                              "steals_this_life": 2}))
+    assert "tpuic_score_rows_quarantined 1" in text
+    assert "tpuic_score_steady_compiles 0" in text
+    assert "# TYPE tpuic_score_rows_scored counter" in text
+    assert render(score_rows(None)) == ""

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -37,7 +38,12 @@ import numpy as np
 from tpuic.data.folder import ImageFolderDataset, quarantined_decode
 from tpuic.data import transforms as T
 
-_PACK_VERSION = 1
+# v2: per-row CRC32s in the meta sidecar, so long-lived caches can be
+# verified row-by-row at READ time (the bulk scorer quarantines rows
+# whose .bin bytes rotted at rest — tpuic/score/driver.py) instead of
+# trusting a fingerprint that only covers the source files. The bump
+# invalidates v1 caches cleanly (the reuse check below).
+_PACK_VERSION = 2
 
 
 def _pack_paths(cache_dir: str, fold: str, size: int) -> Tuple[str, str]:
@@ -114,6 +120,7 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
     # packed labels stay honest — and count the event.
     labels = [int(l) for _, l in dataset.samples]
     image_ids = [dataset.image_id(i) for i in range(n)]
+    row_crc32: List[int] = []
     quarantined = 0
     for i in range(n):
         # Shared quarantine policy (folder.quarantined_decode): retry with
@@ -129,6 +136,7 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
             image_ids[i] = dataset.image_id(j)
             quarantined += 1
         mm[i] = img.reshape(-1)
+        row_crc32.append(zlib.crc32(np.ascontiguousarray(img).tobytes()))
         if verbose and i and i % 2000 == 0:
             rate = i / (time.perf_counter() - t0)
             print(f"[pack] {dataset.fold}: {i}/{n} ({rate:.0f} img/s)",
@@ -149,6 +157,7 @@ def pack_dataset(dataset: ImageFolderDataset, cache_dir: str,
         "image_ids": image_ids,
         "class_to_idx": dataset.class_to_idx,
         "fingerprint": fp,
+        "row_crc32": row_crc32,
     }
     with open(f"{meta_path}.tmp.{token}", "w") as f:
         json.dump(meta, f)
@@ -192,6 +201,9 @@ class PackedDataset:
                             or int(self._labels.min()) >= 0)
         n, s = int(meta["n"]), self.resize_size
         self._mm = np.memmap(bin_path, np.uint8, "r", shape=(n, s, s, 3))
+        # Per-row CRC32s (v2 metas); a pre-v2 cache verifies as
+        # trusted-unverifiable (verify_row True) rather than quarantined.
+        self._row_crc32 = meta.get("row_crc32") or None
         # Pack-time quarantine events (pack_dataset sets the real count on
         # a fresh build; a cache hit reports 0 — the cache's rows were all
         # decodable when written). Epoch-log surfacing reads this.
@@ -218,6 +230,23 @@ class PackedDataset:
 
     def raw(self, index: int) -> np.ndarray:
         return self._mm[index]
+
+    def row_crc32(self, index: int) -> Optional[int]:
+        """The pack-time CRC32 of row ``index`` (None on a pre-v2 meta)."""
+        if self._row_crc32 is None:
+            return None
+        return int(self._row_crc32[index])
+
+    def verify_row(self, index: int) -> bool:
+        """Whether row ``index``'s bytes still hash to their pack-time
+        CRC32 — the at-rest bit-rot check the bulk scorer quarantines
+        on (tpuic/score/driver.py).  True when the meta predates row
+        CRCs: absence of evidence is not a quarantine verdict."""
+        if self._row_crc32 is None:
+            return True
+        import zlib
+        row = np.ascontiguousarray(self._mm[index])
+        return zlib.crc32(row.tobytes()) == int(self._row_crc32[index])
 
     def raw_batch(self, indices) -> np.ndarray:
         """[B,S,S,3] uint8 gather — one C-level fancy-index copy (2x the

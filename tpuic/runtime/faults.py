@@ -115,6 +115,35 @@ Registered points (site → meaning of ``step``):
                       pings go unanswered and the heartbeat goes stale,
                       the shape the router's wedge watchdog escalates
                       via the ``_Child`` SIGQUIT→TERM→KILL ladder.
+- ``scorer_crash``  — bulk-score shard commit (score/commit.py): SIGKILL
+                      this worker in the NASTIEST window — after its
+                      result file is linked into place but before the
+                      CRC manifest and the ledger record exist.
+                      ``step`` is the worker's 1-based shard-commit
+                      ordinal this life; ``#PARAM`` names the victim
+                      rank (default 0, the ``rank_crash`` convention):
+                      ``scorer_crash@1#1`` kills rank 1 at its first
+                      commit.  Drives the survivor's adopt/recover path
+                      (scripts/score_soak.py proves the resumed job's
+                      ledger is exact and bitwise-equal to an
+                      undisturbed baseline).
+- ``shard_corrupt`` — bulk-score shard read (score/driver.py): report
+                      one packed row of shard ``step`` as failing its
+                      stored CRC32 — the at-rest .bin bit-rot verdict,
+                      injected deterministically.  ``#PARAM`` is the row
+                      offset within the shard (default 0).  The row must
+                      land in the ledger's quarantined column with the
+                      corpus accounting still exact (scored +
+                      quarantined == corpus).
+- ``lease_skew``    — shard-lease expiry check (score/work.py): age
+                      every OBSERVED lease by ``param`` extra seconds
+                      (default one full TTL — instant expiry), the
+                      clock-drift that makes a live peer's lease look
+                      dead.  ``step`` is the shard id.  Two live ranks
+                      then score the same shard concurrently; the
+                      commit layer's link-arbitrated exactly-once must
+                      hold and the ledger audit must surface the
+                      duplicate loudly.
 
 Arming: programmatic (tests) via ``arm()``/``disarm()``/``reset()``, or
 the ``TPUIC_FAULTS`` env var for whole-process CLI runs, a comma list of
@@ -158,6 +187,7 @@ REGISTERED_POINTS = frozenset({
     "slow_step", "hard_crash", "hang_step", "flood", "rank_crash",
     "rank_hang", "rank_rejoin_flap", "replica_crash", "replica_wedge",
     "swap_corrupt", "canary_degrade", "bf16_master_truncate",
+    "scorer_crash", "shard_corrupt", "lease_skew",
 })
 
 

@@ -1,0 +1,43 @@
+"""tpuic.score — elastic, exactly-once bulk scoring over a packed corpus.
+
+The offline workload counterpart of tpuic.serve: ``python -m
+tpuic.score`` re-scores an image corpus against a trained checkpoint as
+a gang of independent workers sharing a results directory — shard
+leases for work distribution (rank loss degrades throughput, never the
+job), the checkpoint integrity ladder for per-shard commits (SIGKILL
+anywhere resumes without re-scoring or dropping a shard), and an
+append-only per-rank ledger ``python -m tpuic.telemetry.fleet
+--score-ledger`` audits (scored + quarantined == corpus, duplicates
+loud).  docs/robustness.md "Bulk scoring" is the design reference.
+
+Re-exports resolve lazily (the tpuic/__init__.py idiom): the lease and
+commit layers are stdlib-only; the driver pulls numpy/jax.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "LeaseDir": ("tpuic.score.work", "LeaseDir"),
+    "plan_shards": ("tpuic.score.work", "plan_shards"),
+    "write_or_verify_plan": ("tpuic.score.work", "write_or_verify_plan"),
+    "ShardStore": ("tpuic.score.commit", "ShardStore"),
+    "result_line": ("tpuic.score.commit", "result_line"),
+    "run_score": ("tpuic.score.driver", "run_score"),
+    "main": ("tpuic.score.driver", "main"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,8 @@
+"""``python -m tpuic.score`` — the elastic bulk-scoring worker CLI."""
+
+import sys
+
+from tpuic.score.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,7 +62,19 @@ EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                # transition the trainer acted on — a degrade restores
                # the fleet-agreed step in place (no process restart), a
                # rejoin is noted without a restore.
-               "reform")
+               "reform",
+               # Bulk-scoring tier (tpuic/score/, docs/robustness.md
+               # "Bulk scoring"): one 'score_plan' per worker life (the
+               # shard table), one 'score_shard' per shard attempt
+               # (score/rescore_corrupt/adopt), exactly one
+               # 'score_commit' per committed shard fleet-wide (the
+               # audited ledger row; recovered=true when appended by a
+               # survivor for a dead winner), 'score_duplicate' when
+               # the link-arbitrated commit deduped double work, and
+               # one 'score_done' per worker life (totals + the
+               # steady-compile counter).
+               "score_plan", "score_shard", "score_commit",
+               "score_duplicate", "score_done")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -178,6 +178,130 @@ def membership_timeline(ledger_path: str) -> dict:
     return {"ever_ranks": sorted(ever), "transitions": transitions}
 
 
+# -- bulk-score ledger audit -------------------------------------------------
+def score_audit(streams: Dict[int, List[dict]]) -> dict:
+    """Audit a bulk-scoring job's ledger streams (tpuic/score/):
+    **scored + quarantined == corpus**, per shard and in total, with
+    every violation named — the offline proof the elastic scorer's
+    exactly-once machinery actually held.
+
+    Checks, all loud:
+
+    - a ``score_plan`` record exists and every worker's plan agrees
+      (n, shard count, corpus token) — mixed-job streams fail here;
+    - every planned shard has EXACTLY one ``score_commit`` record
+      fleet-wide: missing shards are dropped work, >1 records are the
+      double-count a ``lease_skew``-style race would smuggle in;
+    - each commit's ``scored + quarantined`` equals its shard's row
+      count, and the totals sum to the corpus size;
+    - commits for shards the plan never defined fail (wrong workdir).
+
+    ``score_duplicate`` events (double work the commit layer deduped)
+    and ``recovered`` commits (records appended by a survivor for a
+    dead winner) are REPORTED but are not violations — they are the
+    recovery machinery working as designed.
+    """
+    recs = [r for rs in streams.values() for r in rs]
+    plans = [r for r in recs if r.get("event") == "score_plan"]
+    errors: List[str] = []
+    if not plans:
+        return {"ok": False, "errors":
+                ["no score_plan record in any stream — not a scoring "
+                 "ledger (or the planner's stream is missing)"]}
+    plan = plans[0]
+    for p in plans[1:]:
+        for key in ("n", "shards", "shard_size", "corpus_token"):
+            if p.get(key) != plan.get(key):
+                errors.append(
+                    f"score_plan disagreement: {key}={p.get(key)!r} vs "
+                    f"{plan.get(key)!r} — streams from different jobs")
+                break
+    n = int(plan.get("n") or 0)
+    table = {i: (int(lo), int(hi)) for i, (lo, hi)
+             in enumerate(plan.get("shard_table") or [])}
+    nshards = int(plan.get("shards") or len(table))
+
+    by_shard: Dict[int, List[dict]] = {}
+    for r in recs:
+        if r.get("event") == "score_commit" and r.get("shard") is not None:
+            by_shard.setdefault(int(r["shard"]), []).append(r)
+    dup_events = sum(1 for r in recs if r.get("event") == "score_duplicate")
+
+    missing = sorted(s for s in range(nshards) if s not in by_shard)
+    duplicated = {s: len(v) for s, v in sorted(by_shard.items())
+                  if len(v) > 1}
+    unknown = sorted(s for s in by_shard if s < 0 or s >= nshards)
+    if missing:
+        errors.append(f"{len(missing)} shard(s) have NO commit record "
+                      f"(dropped work): {missing[:10]}"
+                      + ("..." if len(missing) > 10 else ""))
+    for s, k in duplicated.items():
+        errors.append(f"shard {s} committed {k} times — duplicate "
+                      "records would double-count the corpus")
+    if unknown:
+        errors.append(f"commit record(s) for shard(s) the plan never "
+                      f"defined: {unknown} — wrong workdir or torn plan")
+
+    total_scored = total_quar = recovered = 0
+    bad_rows: List[str] = []
+    for s, commits in sorted(by_shard.items()):
+        if s in unknown:
+            continue
+        c = commits[0]  # duplicates already failed above; audit the first
+        scored = int(c.get("scored") or 0)
+        quar = int(c.get("quarantined") or 0)
+        total_scored += scored
+        total_quar += quar
+        recovered += sum(1 for x in commits if x.get("recovered"))
+        lo, hi = table.get(s, (c.get("lo"), c.get("hi")))
+        if lo is not None and hi is not None \
+                and scored + quar != int(hi) - int(lo):
+            bad_rows.append(
+                f"shard {s}: scored {scored} + quarantined {quar} != "
+                f"{int(hi) - int(lo)} rows [{lo}, {hi})")
+    errors.extend(bad_rows)
+    if not missing and not unknown and total_scored + total_quar != n:
+        errors.append(f"totals: scored {total_scored} + quarantined "
+                      f"{total_quar} != corpus {n}")
+    return {"ok": not errors, "errors": errors, "n": n,
+            "shards": nshards, "shards_committed": len(by_shard),
+            "shards_missing": len(missing),
+            "shards_duplicated": len(duplicated),
+            "rows_scored": total_scored, "rows_quarantined": total_quar,
+            "recovered_records": recovered,
+            "duplicate_score_events": dup_events,
+            "dtype": plan.get("dtype")}
+
+
+def score_summary_lines(report: dict) -> List[str]:
+    """Human rendering of :func:`score_audit` (the CLI's stdout)."""
+    if "n" not in report:
+        return [f"[fleet] score ledger: FAIL — {e}"
+                for e in report.get("errors", ["unauditable"])]
+    lines = [
+        f"[fleet] score ledger: {report['shards_committed']}/"
+        f"{report['shards']} shard(s) committed, "
+        f"{report['rows_scored']} scored + "
+        f"{report['rows_quarantined']} quarantined vs corpus "
+        f"{report['n']}" + (f" (dtype {report['dtype']})"
+                            if report.get("dtype") else "")]
+    if report.get("recovered_records"):
+        lines.append(f"[fleet] score ledger: "
+                     f"{report['recovered_records']} commit record(s) "
+                     "recovered by a survivor (crash-window repair)")
+    if report.get("duplicate_score_events"):
+        lines.append(f"[fleet] score ledger: "
+                     f"{report['duplicate_score_events']} double-scored "
+                     "shard attempt(s) deduped at commit (lease races "
+                     "cost throughput, not correctness)")
+    for e in report.get("errors", []):
+        lines.append(f"[fleet] score ledger FAIL: {e}")
+    if report["ok"]:
+        lines.append("[fleet] score ledger: exact — zero duplicates, "
+                     "zero drops")
+    return lines
+
+
 # -- the skew ledger ---------------------------------------------------------
 def aggregate(streams: Dict[int, List[dict]], warmup: int = 0) -> dict:
     """Merge per-rank event streams into the straggler-attribution
@@ -342,7 +466,35 @@ def main(argv=None) -> int:
                         "ledger never admitted, or a missing member "
                         "stream, still fails). Mutually exclusive with "
                         "--require-ranks")
+    p.add_argument("--score-ledger", action="store_true",
+                   help="audit mode for bulk-scoring ledgers "
+                        "(tpuic/score/): scored + quarantined == corpus "
+                        "per shard and in total, exactly one commit "
+                        "record per shard, duplicates and drops loud — "
+                        "exit 1 on any violation")
+    p.add_argument("--prom-dump", default="", metavar="PATH",
+                   help="with --score-ledger: write the tpuic_score_* "
+                        "Prometheus exposition of the audit here")
     args = p.parse_args(argv)
+
+    if args.score_ledger:
+        streams = load_streams(args.paths)
+        if not streams:
+            print("[fleet] no event streams found", file=sys.stderr)
+            return 2
+        report = score_audit(streams)
+        for line in score_summary_lines(report):
+            print(line, file=sys.stdout if report["ok"] else sys.stderr)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2)
+            print(f"[fleet] report -> {args.json}")
+        if args.prom_dump:
+            from tpuic.telemetry.prom import (render, score_rows,
+                                              write_exposition)
+            write_exposition(args.prom_dump, render(score_rows(report)))
+            print(f"[fleet] prom exposition -> {args.prom_dump}")
+        return 0 if report["ok"] else 1
 
     if args.require_ranks and args.membership:
         print("[fleet] --require-ranks (strict) and --membership "

@@ -146,6 +146,52 @@ def profile_rows(waterfall: Optional[dict]) -> List[Tuple]:
     return rows
 
 
+def score_rows(report: Optional[dict]) -> List[Tuple]:
+    """Bulk-scoring exposition rows (docs/observability.md, "Bulk
+    scoring"): works off either a worker's ``score_done`` summary
+    (tpuic/score/driver.py) or the fleet audit report
+    (telemetry/fleet.py ``score_audit``) — the two share their key
+    vocabulary; fields only one side carries render only there.  None
+    renders nothing."""
+    r = report or {}
+    rows: List[Tuple] = []
+    for field, mtype, help_ in (
+            ("n", "gauge", "corpus rows the scoring plan covers"),
+            ("shards", "gauge", "shards in the scoring plan"),
+            ("shards_committed", "gauge",
+             "shards with a verified result manifest"),
+            ("shards_missing", "gauge",
+             "planned shards with no ledger commit record (audit; "
+             "alert nonzero: dropped work)"),
+            ("shards_duplicated", "gauge",
+             "shards with more than one ledger commit record (audit; "
+             "alert nonzero: double-counted corpus)"),
+            ("rows_scored", "counter", "corpus rows scored"),
+            ("rows_quarantined", "counter",
+             "corpus rows quarantined (undecodable at pack time or "
+             "failing their packed row CRC at read time)"),
+            ("recovered_records", "counter",
+             "ledger commit records appended by a survivor for a dead "
+             "winner (crash-window repair, not a violation)"),
+            ("duplicate_score_events", "counter",
+             "double-scored shard attempts deduped at commit (lease "
+             "races cost throughput, not correctness)"),
+            ("steady_compiles", "gauge",
+             "executables compiled AFTER engine warmup during scoring "
+             "(the zero-steady-state-compile contract; alert nonzero)"),
+            ("steals_this_life", "counter",
+             "expired/orphaned shard leases this worker stole"),
+    ):
+        if r.get(field) is not None:
+            rows.append((f"score_{field}", r[field], mtype, help_, None))
+    if r.get("ok") is not None:
+        rows.append(("score_ledger_exact", 1.0 if r["ok"] else 0.0,
+                     "gauge", "1 when the ledger audit held exactly "
+                     "(scored + quarantined == corpus, zero duplicates, "
+                     "zero drops)", None))
+    return rows
+
+
 def _process_rss_row() -> Tuple:
     """The ``process_rss_bytes`` gauge both expositions render — host
     memory next to the device curve it eventually takes down.  Lazy

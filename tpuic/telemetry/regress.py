@@ -22,7 +22,10 @@ without flaking on a 20% wobble):
   inverse), so a CI runner that is simply 2x slower than the dev box
   that wrote the baseline does not read as a 2x regression.  The scale
   is clamped to [1/4, 4] — beyond that the machines are not comparable
-  and the gate says so instead of silently passing.
+  and the gate refuses to judge: a typed ``environment_mismatch``
+  verdict (exit 3, distinct from regression exit 2), because on such a
+  host every absolute-time row fails identically at seed and tip and a
+  "REGRESSED" verdict would be noise wearing a gate's uniform.
 - **Tolerance ladder.**  Per metric: ``tol = max(floor, NOISE_MULT x
   noise)`` where ``noise`` is the relative trial spread recorded at
   baseline-write time (the same spread discipline bench.py records) and
@@ -335,6 +338,7 @@ def compare(baseline: dict, fresh: Dict[str, float],
     second calibration/tolerance implementation."""
     base_cal = float(baseline.get("calibration_s") or 0.0)
     scale = 1.0
+    mismatch = None
     cal_note = "no baseline calibration — absolute comparison"
     if base_cal > 0 and fresh_calibration > 0:
         scale = fresh_calibration / base_cal
@@ -348,6 +352,16 @@ def compare(baseline: dict, fresh: Dict[str, float],
             scale = 1.0
             cal_note += " — within same-machine band, snapped to 1.0"
         elif not (1.0 / CAL_CLAMP <= scale <= CAL_CLAMP):
+            # Beyond the comparability clamp the machines are NOT
+            # comparable: every absolute-time row would fail (or pass)
+            # identically at seed and tip, which reads as a regression
+            # verdict but means nothing.  Typed environment_mismatch
+            # verdict instead (main() exits 3, distinct from regression
+            # exit 2); the rows below are still computed with the
+            # clamped scale for the report's diagnostic value.
+            mismatch = {"scale": round(scale, 4), "clamp": CAL_CLAMP,
+                        "fresh_calibration_s": round(fresh_calibration, 6),
+                        "baseline_calibration_s": round(base_cal, 6)}
             scale = min(max(scale, 1.0 / CAL_CLAMP), CAL_CLAMP)
             cal_note += f" — CLAMPED to {scale:.3f}: machines barely comparable"
     rows = []
@@ -384,10 +398,33 @@ def compare(baseline: dict, fresh: Dict[str, float],
                      "tolerance": round(tol, 4), "direction": direction,
                      "kind": kind, "noise": noise})
     bad = [r for r in rows if r["status"] == "REGRESSED"]
-    return {"regressed": bool(bad),
-            "regressed_metrics": [r["metric"] for r in bad],
-            "calibration": cal_note, "scale": round(scale, 4),
-            "rows": rows}
+    out = {"regressed": bool(bad),
+           "regressed_metrics": [r["metric"] for r in bad],
+           "calibration": cal_note, "scale": round(scale, 4),
+           "rows": rows}
+    if mismatch is not None:
+        out["environment_mismatch"] = mismatch
+    return out
+
+
+def verdict_exit(report: dict, expect_fail: bool = False) -> int:
+    """The gate's exit code for a :func:`compare` report.
+
+    3 — ``environment_mismatch``: the host is outside the ``CAL_CLAMP``
+        comparability clamp, so pass/fail would be identical at seed
+        and tip; the typed verdict REFUSES to judge (and overrides
+        ``--expect-fail``: a gate that cannot fire meaningfully cannot
+        prove it fires either).  Distinct from regression exit 2, so CI
+        and humans can tell "this PR is slow" from "this host is".
+    2 — a gated metric regressed (or, under ``expect_fail``, the seeded
+        slowdown failed to trip the gate).
+    0 — clean (or, under ``expect_fail``, the expected failure fired).
+    """
+    if report.get("environment_mismatch"):
+        return 3
+    if expect_fail:
+        return 0 if report["regressed"] else 2
+    return 2 if report["regressed"] else 0
 
 
 def _print_report(report: dict) -> None:
@@ -432,7 +469,10 @@ def main(argv=None) -> int:
     mode = p.add_mutually_exclusive_group(required=True)
     mode.add_argument("--check", action="store_true",
                       help="run the pinned workload and compare against "
-                           "the committed baseline; exit 2 on regression")
+                           "the committed baseline; exit 2 on regression, "
+                           "3 when the host is outside the calibration "
+                           "comparability clamp (environment_mismatch — "
+                           "no verdict, not a regression)")
     mode.add_argument("--write-baseline", action="store_true",
                       help="run --trials trials of the workload and "
                            "(re)write the baseline file")
@@ -509,15 +549,24 @@ def main(argv=None) -> int:
         with open(args.report, "w") as f:
             json.dump(report, f, indent=2)
         print(f"[regress] comparison -> {args.report}")
-    if args.expect_fail:
-        if report["regressed"]:
+    code = verdict_exit(report, expect_fail=args.expect_fail)
+    if code == 3:
+        em = report["environment_mismatch"]
+        print(f"[regress] ENVIRONMENT MISMATCH: this host's calibration "
+              f"is {em['scale']:g}x the baseline's — beyond the "
+              f"{em['clamp']:g}x comparability clamp. Seed and tip would "
+              f"fail identically here; refusing a pass/fail verdict "
+              f"(exit 3, distinct from regression exit 2). Re-baseline "
+              f"on this host class or gate on a comparable runner.",
+              file=sys.stderr)
+    elif args.expect_fail:
+        if code == 0:
             print("[regress] expected failure observed — the gate can "
                   "fire (bidirectional proof OK)")
-            return 0
-        print("[regress] ERROR: seeded slowdown did NOT trip the gate — "
-              "the gate is decoration", file=sys.stderr)
-        return 2
-    return 2 if report["regressed"] else 0
+        else:
+            print("[regress] ERROR: seeded slowdown did NOT trip the "
+                  "gate — the gate is decoration", file=sys.stderr)
+    return code
 
 
 if __name__ == "__main__":
