@@ -382,7 +382,11 @@ def main(argv=None) -> int:
         input_dtype=np.uint8, buckets=buckets,
         max_wait_ms=args.max_wait_ms, queue_size=max(64, args.requests),
         variants={k: v for k, v in variants.items() if k != "fp32"})
-    warmup_s = engine.warmup()
+    # Shared warmup helper (tpuic/compiled/): every (variant, bucket)
+    # rung AOT-compiles through the process-wide registry; regress.py
+    # dedups onto the same call.
+    from tpuic.compiled import warm_engine
+    warmup_s = warm_engine(engine)
     curves = []
     for rate_s in args.rates.split(","):
         curves.append(_engine_run(engine, reqs, float(rate_s)))

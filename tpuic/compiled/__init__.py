@@ -1,0 +1,37 @@
+"""tpuic.compiled — the process-wide compiled-program registry
+(docs/performance.md, "Compiled-program registry").
+
+One executable cache for train, serve, and bench: ``ProgramKey`` keys
+``(model, shapes, mesh, dtype, generation)``, ``registry`` owns
+lowering/AOT compilation, cost-analysis capture, hit/miss/prewarm
+accounting, generation-scoped GC, and the donation-safety policy
+(:func:`donation_allowed`); ``manifest`` persists compiled keys so
+restarts prewarm every known program up front.
+"""
+
+from tpuic.compiled.manifest import (MANIFEST_VERSION, ManifestError,
+                                     load_manifest, save_manifest)
+from tpuic.compiled.registry import (CompiledEntry, ProgramKey,
+                                     ProgramRegistry, avals_crc,
+                                     donation_allowed, registry, stable_crc,
+                                     tree_avals)
+
+__all__ = [
+    "ProgramKey", "CompiledEntry", "ProgramRegistry", "registry",
+    "donation_allowed", "tree_avals", "avals_crc", "stable_crc",
+    "MANIFEST_VERSION", "ManifestError", "load_manifest", "save_manifest",
+    "warm_engine",
+]
+
+
+def warm_engine(engine, manifest_path=None):
+    """The shared serve warmup helper ``bench_serve.py`` / ``regress.py``
+    deduplicate onto: AOT-compile every (variant, bucket) rung through
+    the registry (``engine.warmup()`` routes there), optionally
+    persisting the compiled keys to ``manifest_path`` so the next
+    process prewarms from disk.  Returns ``engine.warmup()``'s timing
+    dict unchanged (``{bucket: secs}`` or ``{variant: {bucket: secs}}``)."""
+    timings = engine.warmup()
+    if manifest_path:
+        registry.write_manifest(manifest_path)
+    return timings

@@ -1,0 +1,92 @@
+"""On-disk prewarm manifest (docs/performance.md, "Compiled-program
+registry").
+
+A tiny JSON sidecar recording the :class:`~tpuic.compiled.ProgramKey`\\ s
+a process compiled, so the NEXT process — a restarted gang member, a
+hot-swap candidate, a cold replica — compiles every known program up
+front (against the persistent XLA compilation cache, where those
+compiles are disk reads) instead of paying them at first traffic.
+
+Write discipline matches the checkpoint manager's sidecars
+(tpuic/checkpoint/manager.py): the payload is written to a tmp file and
+``os.replace``\\ d into place so readers never see a half-written
+manifest, and it carries a CRC32 of its canonical entries JSON.  A
+reader that finds a CRC mismatch, an unknown version, or unparseable
+JSON REFUSES the manifest (:class:`ManifestError`) — prewarming from a
+torn file would compile garbage keys and report them as coverage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+from typing import List
+
+__all__ = ["MANIFEST_VERSION", "ManifestError", "save_manifest",
+           "load_manifest"]
+
+MANIFEST_VERSION = 1
+
+
+class ManifestError(ValueError):
+    """A prewarm manifest that must not be trusted (torn write, CRC
+    mismatch, unknown schema).  Callers skip prewarm loudly; they never
+    prewarm from a manifest that failed this check."""
+
+
+def _entries_crc(entries: List[dict]) -> str:
+    payload = json.dumps(entries, sort_keys=True, separators=(",", ":"))
+    return f"{zlib.crc32(payload.encode()) & 0xFFFFFFFF:08x}"
+
+
+def save_manifest(path: str, entries: List[dict]) -> None:
+    """Atomically write ``entries`` (``[{"key": ProgramKey.to_dict(),
+    "compile_s": float}, ...]``) with a payload CRC."""
+    doc = {"version": MANIFEST_VERSION, "crc": _entries_crc(entries),
+           "entries": entries}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".manifest-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_manifest(path: str) -> List[dict]:
+    """Read + verify a prewarm manifest.  Returns the entries list.
+    Raises :class:`ManifestError` on any integrity failure and
+    ``FileNotFoundError`` when the file simply does not exist (a first
+    boot — not an error)."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as e:
+            raise ManifestError(f"prewarm manifest {path} is not valid "
+                                f"JSON ({e}) — refusing to prewarm") from e
+    if not isinstance(doc, dict) or doc.get("version") != MANIFEST_VERSION:
+        raise ManifestError(
+            f"prewarm manifest {path} has unknown version "
+            f"{doc.get('version') if isinstance(doc, dict) else type(doc)} "
+            f"(expected {MANIFEST_VERSION}) — refusing to prewarm")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise ManifestError(f"prewarm manifest {path} carries no entries "
+                            "list — refusing to prewarm")
+    crc = _entries_crc(entries)
+    if crc != doc.get("crc"):
+        raise ManifestError(
+            f"prewarm manifest {path} failed its CRC check "
+            f"(recorded {doc.get('crc')!r}, computed {crc!r}) — torn or "
+            "tampered; refusing to prewarm")
+    return entries

@@ -1,0 +1,334 @@
+"""Process-wide compiled-program registry (docs/performance.md,
+"Compiled-program registry").
+
+The reference paper's pipeline compiles exactly one program; this repo
+compiles dozens — per-bucket serve executables across a dtype ladder,
+train/eval steps that re-jit on elastic reform, bench/regress warmup
+programs.  Before this module each consumer grew its own cache (the
+serve generation's ``(variant, bucket)`` dict, the trainer's re-jit on
+``_build_steps``, warmup loops in ``bench.py``/``bench_serve.py``/
+``regress.py``).  The registry is the one home for all of them:
+
+- ``ProgramKey`` — ``(model, shapes, mesh, dtype, generation)``, the
+  compatibility key.  Two call sites with equal keys may share one
+  executable; anything that changes the compiled program (input avals,
+  mesh extent, ladder dtype, a replaced forward) must change the key.
+- ``get_or_compile(key, build_fn)`` — hit returns the cached entry
+  (executable + captured ``cost_analysis``); miss runs ``build_fn``
+  under the registry lock (racing compilers for the same key would
+  otherwise both compile and the compiles-flat contract would report
+  phantom recompiles) and records compile wall time + cost analysis.
+- generation-scoped GC — ``retire(model, generation=g)`` drops a
+  retired serve generation's entries; a pre-reform trainer step evicts
+  its superseded keys the same way.  Entries never outlive the program
+  identity that built them.
+- the donation-safety policy — :func:`donation_allowed` is the single
+  authoritative implementation of the cpu+cache+guard donation-disable
+  rule (previously inlined in train/step.py; TPU201/202 in
+  tpuic/analysis/rules.py codify the underlying backend bug).
+- hit/miss/prewarm accounting — ``counters()`` feeds the
+  ``compile_cache_{hits,misses,prewarmed,entries}`` rows both prom
+  expositions render, and every compile/retire publishes a
+  ``compile_cache`` event so restart downtime is attributable to
+  compile vs everything else.
+
+The prewarm manifest (tpuic/compiled/manifest.py) persists the keys a
+process compiled so a restarted gang member, a hot-swap candidate, or a
+cold replica compiles every known program up front — against the
+persistent XLA cache that makes those compiles disk reads — instead of
+paying them at first traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "ProgramKey", "CompiledEntry", "ProgramRegistry", "registry",
+    "donation_allowed", "tree_avals", "avals_crc", "stable_crc",
+]
+
+
+def tree_avals(variables) -> tuple:
+    """Hashable (path, shape, dtype) signature of a pytree — the
+    executable-compatibility signature: two trees with equal signatures
+    can run through the same AOT executables (variables are *arguments*
+    of the compiled program, not baked into it).  Moved here from
+    serve/engine.py — the serve hot-swap reuse test and the trainer's
+    aval-identical reform both key on it."""
+    import jax
+    return tuple(
+        (jax.tree_util.keystr(path), tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0])
+
+
+def avals_crc(avals: tuple) -> str:
+    """8-hex CRC of an aval signature — compact enough to live inside a
+    ProgramKey (the full signature of a ResNet tree is hundreds of
+    entries) while still discriminating shape/dtype/structure changes."""
+    return f"{zlib.crc32(repr(avals).encode()) & 0xFFFFFFFF:08x}"
+
+
+def stable_crc(obj) -> str:
+    """8-hex CRC of any JSON-able object (sort_keys canonical form) —
+    how consumers fold config blobs (optimizer, sharding flags, seeds)
+    into a key without exploding its repr."""
+    payload = json.dumps(obj, sort_keys=True, default=str)
+    return f"{zlib.crc32(payload.encode()) & 0xFFFFFFFF:08x}"
+
+
+def _tuplify(x):
+    """Lists -> tuples, recursively: manifest JSON round-trips keys
+    through lists, but ProgramKey fields must stay hashable."""
+    if isinstance(x, (list, tuple)):
+        return tuple(_tuplify(v) for v in x)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramKey:
+    """The registry key: ``(model, shapes, mesh, dtype, generation)``.
+
+    ``model``      — program family tag ("serve:<tag>/fp32",
+                     "train:resnet18-cifar:step", ...).  Consumers that
+                     want cross-process manifest prewarm must use a tag
+                     stable across restarts; anything else should make
+                     it unique (a colliding tag with a different program
+                     body would alias two incompatible executables).
+    ``shapes``     — input geometry + whatever aval/config CRCs pin the
+                     program body (nested tuples of primitives).
+    ``mesh``       — ((axis, size), ...) of the SPMD mesh, () unsharded.
+    ``dtype``      — compute/ladder dtype tag ("fp32", "bf16", ...).
+    ``generation`` — program generation; bumps when the program body
+                     changes under an unchanged geometry (a hot-swap
+                     that replaced the forward fn), so retiring a
+                     generation GCs exactly its entries.
+    """
+
+    model: str
+    shapes: tuple = ()
+    mesh: tuple = ()
+    dtype: str = ""
+    generation: int = 0
+
+    def to_dict(self) -> dict:
+        return {"model": self.model, "shapes": list(self.shapes),
+                "mesh": list(self.mesh), "dtype": self.dtype,
+                "generation": self.generation}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProgramKey":
+        return cls(model=str(d["model"]), shapes=_tuplify(d.get("shapes", ())),
+                   mesh=_tuplify(d.get("mesh", ())),
+                   dtype=str(d.get("dtype", "")),
+                   generation=int(d.get("generation", 0)))
+
+
+@dataclasses.dataclass
+class CompiledEntry:
+    """One cached program: the executable (an AOT ``Compiled`` or a
+    jitted callable), its captured cost analysis (best-effort; {} when
+    the backend exposes none — lazy-jit entries cost-analyze at first
+    lowering, not here), compile wall time, and per-entry accounting."""
+
+    key: ProgramKey
+    executable: object
+    cost: dict
+    compile_s: float
+    hit_count: int = 0
+    prewarmed: bool = False
+
+
+def donation_allowed(*, guard_active: bool) -> bool:
+    """THE cpu+cache+guard donation-disable rule — the registry is its
+    single authoritative home (train/step.py and any future AOT
+    consumer call this instead of re-deriving it).
+
+    Buffer donation must be disabled exactly when all three hold:
+    (a) the caller's program aliases donated inputs straight to outputs
+    (the non-finite guard's skip path — ``guard_active``), (b) a
+    persistent XLA compilation cache is configured, and (c) the backend
+    is CPU.  Executables DESERIALIZED from the persistent cache
+    mishandle input->output aliasing on this container's jax 0.4.37 CPU
+    backend — measured as silent buffer corruption (NaN loss on finite
+    data after a restore) and nondeterministic SIGSEGV in dispatch; any
+    two of the three conditions are fine.  TPU201/TPU202
+    (tpuic/analysis/rules.py) lint for the same hazard statically."""
+    if not guard_active:
+        return True
+    import jax
+    if not getattr(jax.config, "jax_compilation_cache_dir", None):
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def _publish(kind: str, **data) -> None:
+    # Best-effort bus publish: the registry must work in processes that
+    # never import telemetry (and before the bus exists in interpreter
+    # teardown paths).
+    try:
+        from tpuic.telemetry.events import publish
+        publish(kind, **data)
+    except Exception:
+        pass
+
+
+class ProgramRegistry:
+    """The process-wide executable cache.  Thread-safe: ``get_or_compile``
+    holds one registry lock across the build (the same serialization the
+    serve engine's compile lock provided — two threads racing the same
+    key compile once), while ``peek`` is a lock-free dict read for the
+    request path.  Hit counters are GIL-approximate under true
+    multithreading (a lost increment, never a lost entry); every test
+    that asserts exact counts is single-threaded."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: Dict[ProgramKey, CompiledEntry] = {}
+        self.hits = 0
+        self.misses = 0
+        self.prewarmed = 0
+        self.compile_s = 0.0
+
+    # -- core ----------------------------------------------------------
+    def get_or_compile(self, key: ProgramKey,
+                       build_fn: Callable[[], object], *,
+                       prewarm: bool = False) -> CompiledEntry:
+        """Return the cached entry for ``key``, compiling via
+        ``build_fn`` on miss.  The freshly-built entry has
+        ``hit_count == 0`` on exactly the call that built it — callers
+        that keep their own compile stats (ServeStats) branch on that.
+        ``prewarm=True`` marks a miss as manifest/startup prewarm work
+        in the counters (it is still a real compile)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.hit_count += 1
+                self.hits += 1
+                return entry
+            t0 = time.perf_counter()
+            exe = build_fn()
+            compile_s = time.perf_counter() - t0
+            cost: dict = {}
+            try:
+                from tpuic.telemetry.goodput import cost_analysis_dict
+                cost = dict(cost_analysis_dict(exe))
+            except Exception:
+                cost = {}
+            entry = CompiledEntry(key=key, executable=exe, cost=cost,
+                                  compile_s=compile_s, prewarmed=prewarm)
+            self._entries[key] = entry
+            self.misses += 1
+            self.compile_s += compile_s
+            if prewarm:
+                self.prewarmed += 1
+            _publish("compile_cache",
+                     action="prewarm" if prewarm else "compile",
+                     model=key.model, dtype=key.dtype,
+                     generation=key.generation,
+                     compile_ms=round(1000.0 * compile_s, 3),
+                     entries=len(self._entries))
+            return entry
+
+    def peek(self, key: ProgramKey):
+        """Lock-free executable lookup for the request path: the cached
+        executable, or None.  Counts a hit on success (approximate under
+        contention — see class docstring)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        entry.hit_count += 1
+        self.hits += 1
+        return entry.executable
+
+    def lookup(self, key: ProgramKey) -> Optional[CompiledEntry]:
+        """Non-counting introspection: the entry, or None."""
+        return self._entries.get(key)
+
+    def mark_prewarmed(self, key: ProgramKey) -> bool:
+        """Flag an existing entry as prewarmed (a startup path executed
+        it before first traffic) — counted once per entry."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.prewarmed:
+                return False
+            entry.prewarmed = True
+            self.prewarmed += 1
+            return True
+
+    # -- generation-scoped GC ------------------------------------------
+    def retire(self, model_prefix: str, *,
+               generation: Optional[int] = None) -> int:
+        """Drop every entry whose ``key.model`` starts with
+        ``model_prefix`` (and, when given, whose ``key.generation``
+        matches) — how a superseded serve generation or a pre-reform
+        trainer step releases its executables.  Returns the count."""
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k.model.startswith(model_prefix)
+                      and (generation is None or k.generation == generation)]
+            for k in doomed:
+                del self._entries[k]
+        if doomed:
+            _publish("compile_cache", action="retire", model=model_prefix,
+                     generation=generation, retired=len(doomed),
+                     entries=len(self._entries))
+        return len(doomed)
+
+    def evict(self, key: ProgramKey) -> bool:
+        """Drop one exact key (trainer reform GC of the superseded step)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+        if entry is not None:
+            _publish("compile_cache", action="retire", model=key.model,
+                     generation=key.generation, retired=1,
+                     entries=len(self._entries))
+        return entry is not None
+
+    # -- introspection --------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def keys(self) -> List[ProgramKey]:
+        return list(self._entries)
+
+    def counters(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "prewarmed": self.prewarmed, "entries": len(self._entries),
+                "compile_s": round(self.compile_s, 4)}
+
+    def manifest_entries(self, model_prefix: str = "") -> List[dict]:
+        """JSON-able records of every (matching) compiled key — what the
+        prewarm manifest persists."""
+        with self._lock:
+            return [{"key": e.key.to_dict(),
+                     "compile_s": round(e.compile_s, 4)}
+                    for e in self._entries.values()
+                    if e.key.model.startswith(model_prefix)]
+
+    def write_manifest(self, path: str, model_prefix: str = "") -> int:
+        """Persist the compiled-key manifest atomically (tmp+rename with
+        a payload CRC — tpuic/compiled/manifest.py).  Returns the entry
+        count written."""
+        from tpuic.compiled.manifest import save_manifest
+        entries = self.manifest_entries(model_prefix)
+        save_manifest(path, entries)
+        return len(entries)
+
+    def reset(self) -> None:
+        """Tests only: drop every entry and zero the counters."""
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.prewarmed = 0
+            self.compile_s = 0.0
+
+
+#: The process-wide registry every consumer shares (serve engine,
+#: trainer, bench/regress warmup, the prom expositions' counter rows).
+registry = ProgramRegistry()

@@ -129,6 +129,12 @@ class _Request:
                          else self.t_enqueue + float(deadline_ms) / 1000.0)
 
 
+#: Default per-engine registry namespace suffix — unique per instance so
+#: two engines never alias executables unless a caller explicitly claims
+#: program identity via ``cache_tag``.
+_ENGINE_SEQ = itertools.count()
+
+
 def _tree_digest(variables) -> str:
     """Content digest of a variables tree: CRC32 folded over every
     leaf's path, shape, dtype, and bytes — 8 hex chars.  This is the
@@ -152,37 +158,39 @@ def _tree_digest(variables) -> str:
 
 def _tree_avals(variables):
     """Hashable (path, shape, dtype) signature of a tree — the
-    executable-compatibility key: two trees with equal signatures can
-    run through the same AOT executables (variables are *arguments* of
-    the compiled forward, not baked into it)."""
-    import jax
-    return tuple(
-        (jax.tree_util.keystr(path), tuple(getattr(leaf, "shape", ())),
-         str(getattr(leaf, "dtype", type(leaf).__name__)))
-        for path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0])
+    executable-compatibility key (tpuic.compiled.tree_avals; kept as a
+    module name here because swap/candidate call sites and their tests
+    predate the registry)."""
+    from tpuic.compiled import tree_avals
+    return tree_avals(variables)
 
 
 class _Generation:
     """One immutable serving generation (docs/serving.md, "Model
     lifecycle: hot-swap, canary, rollback"): the variant map
-    ``{tag: (forward, device-resident variables)}`` plus the AOT
-    executables those variants run through.  The engine holds exactly
-    one live reference (``engine._gen``); ``swap_weights`` builds the
-    next generation completely off-path — staged on device, executables
-    reused or prewarmed — and then flips that single reference, so a
-    device batch (which reads the reference once, at dispatch) is
-    all-old or all-new, never mixed, and nothing ever drains.
+    ``{tag: (forward, device-resident variables)}`` plus the registry
+    keys (tpuic.compiled) its AOT executables live under.  The engine
+    holds exactly one live reference (``engine._gen``); ``swap_weights``
+    builds the next generation completely off-path — staged on device,
+    executables reused or prewarmed in the registry — and then flips
+    that single reference, so a device batch (which reads the reference
+    once, at dispatch) is all-old or all-new, never mixed, and nothing
+    ever drains.
 
-    ``executables`` may be SHARED with the previous generation when the
-    new trees are aval-identical (the executables take variables as
-    call arguments — same shapes/dtypes means zero recompiles)."""
+    ``program_gen`` is the registry generation the keys carry: it is
+    SHARED with the previous serving generation when the new trees are
+    aval-identical and no forward was replaced (the executables take
+    variables as call arguments — same shapes/dtypes means the same
+    keys, zero recompiles), and bumped otherwise, so retiring the old
+    program generation GCs exactly the superseded executables."""
 
-    __slots__ = ("variants", "executables", "generation", "digest")
+    __slots__ = ("variants", "keys", "program_gen", "generation", "digest")
 
-    def __init__(self, variants: dict, executables: dict,
+    def __init__(self, variants: dict, keys: dict, program_gen: int,
                  generation: int, digest: str) -> None:
         self.variants = variants
-        self.executables = executables
+        self.keys = keys          # {(variant, bucket): ProgramKey}
+        self.program_gen = program_gen
         self.generation = generation
         self.digest = digest
 
@@ -303,6 +311,7 @@ class InferenceEngine:
                  forward_fn=None, stats: Optional[ServeStats] = None,
                  admission=None, variants: Optional[dict] = None,
                  default_variant: str = "fp32",
+                 cache_tag: Optional[str] = None,
                  autostart: bool = True) -> None:
         import jax
 
@@ -338,17 +347,31 @@ class InferenceEngine:
             if tag == self.default_variant:
                 continue  # the constructor pair IS the default rung
             gen_variants[tag] = (fwd, jax.device_put(vs))
+        # Executable home: the process-wide compiled-program registry
+        # (tpuic/compiled, docs/performance.md "Compiled-program
+        # registry") — this engine owns no private executable cache.
+        # ``cache_tag`` namespaces its keys: the default is unique per
+        # engine instance (two engines with coincidentally identical
+        # aval signatures but different forward closures — e.g.
+        # normalize on vs off — must never alias executables); callers
+        # that want cross-process manifest prewarm pass a tag that is
+        # BOTH stable across restarts and a full program identity
+        # (model + preprocessing config), asserting that identity.
+        from tpuic.compiled import registry as _program_registry
+        self._registry = _program_registry
+        self._cache_tag = (str(cache_tag) if cache_tag
+                           else f"serve:{next(_ENGINE_SEQ)}")
         # The live generation (docs/serving.md, "Model lifecycle"): ONE
         # reference the batcher reads once per dispatch; swap_weights
         # flips it between batches — atomic hot-swap, nothing drains.
-        self._gen = _Generation(gen_variants, {}, 0,
+        self._gen = _Generation(gen_variants,
+                                self._program_keys(gen_variants, 0), 0, 0,
                                 _tree_digest(variables))
         # The boot digest: the canary_degrade fault point keys off
         # "serving weights other than the ones this process booted
         # with" (runtime/faults.py) — rollback restores the boot digest
         # and stands the fault down.
         self._boot_digest = self._gen.digest
-        self._compile_lock = threading.Lock()
         self._swap_lock = threading.Lock()
         self._jax = jax
         self.stats = stats if stats is not None else ServeStats()
@@ -377,8 +400,34 @@ class InferenceEngine:
 
     @property
     def _executables(self) -> dict:
-        """The live generation's AOT executable cache."""
-        return self._gen.executables
+        """Registry view of the live generation's compiled executables:
+        ``{(variant, bucket): executable}`` for every key that has
+        compiled.  A derived read — the registry (tpuic/compiled) owns
+        the cache; this engine holds no private copy."""
+        out = {}
+        for vb, key in self._gen.keys.items():
+            entry = self._registry.lookup(key)
+            if entry is not None:
+                out[vb] = entry.executable
+        return out
+
+    def _program_keys(self, variants: dict, program_gen: int) -> dict:
+        """Precompute the registry key of every (variant, bucket) pair:
+        the per-rung variables aval CRC pins the program signature (an
+        aval-identical hot-swap recomputes IDENTICAL keys and therefore
+        hits; any shape/dtype/structure change misses), the bucketed
+        input spec is the shapes field, and ``program_gen`` scopes GC."""
+        from tpuic.compiled import ProgramKey, avals_crc, tree_avals
+        keys = {}
+        for tag, (_fwd, tree) in variants.items():
+            crc = avals_crc(tree_avals(tree))
+            for b in self.buckets:
+                keys[(tag, b)] = ProgramKey(
+                    model=f"{self._cache_tag}/{tag}",
+                    shapes=((b, self.image_size, self.image_size,
+                             self.channels), str(self.input_dtype), crc),
+                    mesh=(), dtype=tag, generation=program_gen)
+        return keys
 
     @property
     def generation(self) -> int:
@@ -473,38 +522,34 @@ class InferenceEngine:
             return per_variant[self.default_variant]
         return per_variant
 
-    def _compile(self, gen: _Generation, variant: str, bucket: int):
-        # Serialized: warmup() (caller thread), the batcher's lazy
-        # fallback, and a swap's off-path prewarm may race on the same
-        # bucket; without the lock both would compile it and the
-        # compiles-flat contract would report phantom recompiles.
-        with self._compile_lock:
-            exe = gen.executables.get((variant, bucket))
-            if exe is not None:
-                return exe
+    def _compile(self, gen: _Generation, variant: str, bucket: int,
+                 prewarm: bool = False):
+        # The registry lock serializes racing compilers for the same
+        # key (warmup in the caller thread, the batcher's lazy fallback,
+        # a swap's off-path prewarm) — without it both would compile and
+        # the compiles-flat contract would report phantom recompiles.
+        key = gen.keys[(variant, bucket)]
+
+        def build():
             forward, variables = gen.variants[variant]
             spec = self._jax.ShapeDtypeStruct(
                 (bucket, self.image_size, self.image_size, self.channels),
                 self.input_dtype)
-            t0 = time.perf_counter()
-            exe = self._jax.jit(forward).lower(variables, spec).compile()
-            self.stats.record_compile(bucket, time.perf_counter() - t0)
-            # Roofline context where the runtime exposes it: the
-            # AOT-lowered executable's FLOPs/bytes per call
-            # (docs/observability.md, "Device-time attribution").
-            # Best-effort — a backend without cost analysis serves
-            # identically, just without the exposition rows.
-            try:
-                from tpuic.telemetry.goodput import cost_analysis_dict
-                ca = cost_analysis_dict(exe)
-                self.stats.record_cost(bucket,
-                                       float(ca.get("flops", 0.0)),
-                                       float(ca.get("bytes accessed",
-                                                    0.0)))
-            except Exception:
-                pass
-            gen.executables[(variant, bucket)] = exe
-            return exe
+            return self._jax.jit(forward).lower(variables, spec).compile()
+
+        entry = self._registry.get_or_compile(key, build, prewarm=prewarm)
+        if entry.hit_count == 0:
+            # This call built it: fold the registry's recorded compile
+            # time + cost analysis into the engine-lifetime serve stats
+            # (roofline context for the span ledger's device phase —
+            # docs/observability.md; cost is best-effort, a backend
+            # without cost analysis serves identically).
+            self.stats.record_compile(bucket, entry.compile_s)
+            if entry.cost:
+                self.stats.record_cost(
+                    bucket, float(entry.cost.get("flops", 0.0)),
+                    float(entry.cost.get("bytes accessed", 0.0)))
+        return entry.executable
 
     def profile_waterfall(self):
         """Per-op-class device-time waterfall of the largest warmed
@@ -560,7 +605,10 @@ class InferenceEngine:
             return None
 
     def _executable_for(self, gen: _Generation, variant: str, bucket: int):
-        exe = gen.executables.get((variant, bucket))
+        # Lock-free registry read on the request path (the registry's
+        # peek is one dict lookup — the same cost the old private
+        # executables dict paid).
+        exe = self._registry.peek(gen.keys[(variant, bucket)])
         if exe is None:
             # Lazy fallback so an un-warmed engine still works; counted,
             # so the compile-flat-after-warmup test catches any batcher
@@ -568,6 +616,27 @@ class InferenceEngine:
             return self._compile(gen, variant, bucket)
         self.stats.record_cache_hit()
         return exe
+
+    def prewarm(self, manifest_path: str) -> int:
+        """Manifest-driven cold-start prewarm (docs/performance.md):
+        compile every (variant, bucket) executable the manifest lists
+        for this engine's keys BEFORE first traffic — against the
+        persistent XLA cache those compiles are disk reads.  Requires a
+        stable ``cache_tag`` (the default per-instance tag never
+        matches across processes).  Raises
+        :class:`tpuic.compiled.ManifestError` on a corrupt manifest —
+        refusal, never best-effort — and ``FileNotFoundError`` when no
+        manifest exists yet.  Returns the number of programs compiled."""
+        from tpuic.compiled import ProgramKey, load_manifest
+        listed = {ProgramKey.from_dict(e["key"])
+                  for e in load_manifest(manifest_path)}
+        gen = self._gen
+        n = 0
+        for (variant, bucket), key in gen.keys.items():
+            if key in listed and self._registry.lookup(key) is None:
+                self._compile(gen, variant, bucket, prewarm=True)
+                n += 1
+        return n
 
     # -- atomic hot-swap (docs/serving.md, "Model lifecycle") -----------
     def swap_weights(self, variables=None, *, variants: Optional[dict]
@@ -642,21 +711,35 @@ class InferenceEngine:
             reused = not replaced_forward and all(
                 _tree_avals(tree) == _tree_avals(cur.variants[tag][1])
                 for tag, (_, tree) in put.items())
+            # Aval-identical + same forward => the recomputed registry
+            # keys are IDENTICAL to the incumbent's (same aval CRCs,
+            # same program generation) — every lookup hits, zero
+            # recompiles.  Otherwise the program generation bumps: the
+            # new keys all miss (prewarmed below) and the incumbent's
+            # entries are retired after the flip.
+            program_gen = cur.program_gen if reused else cur.program_gen + 1
             new_gen = _Generation(
-                put, cur.executables if reused else {},
+                put, self._program_keys(put, program_gen), program_gen,
                 cur.generation + 1, digest)
             prewarmed = 0
             if not reused:
-                # Off-path prewarm: compiles land in the NEW
-                # generation's cache while the incumbent generation
-                # keeps serving; counted honestly in stats.compiles
-                # (they are real compiles — just never on the request
-                # path, and never after the flip).
+                # Off-path prewarm: compiles land in the registry under
+                # the NEW program generation while the incumbent keeps
+                # serving; counted honestly in stats.compiles (they are
+                # real compiles — just never on the request path, and
+                # never after the flip).
                 for tag in new_gen.variants:
                     for b in self.buckets:
                         self._compile(new_gen, tag, b)
                         prewarmed += 1
             self._gen = new_gen  # THE flip — one reference, atomic
+            if not reused:
+                # Generation-scoped GC: the superseded program
+                # generation's executables can never serve again.  The
+                # trailing "/" keeps the prefix exact ("serve:1" must
+                # not retire "serve:10").
+                self._registry.retire(self._cache_tag + "/",
+                                      generation=cur.program_gen)
             # Stats + event INSIDE the swap lock: a later swap's
             # record_swap must not land before an earlier one's, or the
             # exposed generation/digest would disagree with what is

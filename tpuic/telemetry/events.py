@@ -74,7 +74,15 @@ EVENT_KINDS = ("step", "epoch", "eval", "drain", "checkpoint_commit",
                # one 'score_done' per worker life (totals + the
                # steady-compile counter).
                "score_plan", "score_shard", "score_commit",
-               "score_duplicate", "score_done")
+               "score_duplicate", "score_done",
+               # Compiled-program registry (tpuic/compiled/,
+               # docs/performance.md "Compiled-program registry"): one
+               # 'compile_cache' event per registry action — a miss that
+               # compiled (action=compile), a manifest-driven prewarm
+               # compile (action=prewarm), a generation retirement
+               # (action=retire), and the trainer's prewarm summary
+               # (action=prewarm_done).
+               "compile_cache")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -109,6 +109,34 @@ def memory_rows(memory: Optional[dict]) -> List[Tuple]:
     return rows
 
 
+def compile_cache_rows() -> List[Tuple]:
+    """Compiled-program registry counters (tpuic/compiled/registry.py,
+    docs/performance.md "Compiled-program registry") -> exposition rows.
+    The registry is a process-wide singleton shared by train, serve, and
+    bench, so both expositions render the same four rows: hit/miss/
+    prewarm counters plus the live entry count.  Lazily imported so the
+    telemetry tier keeps working if tpuic.compiled is absent."""
+    try:
+        from tpuic.compiled import registry
+        c = registry.counters()
+    except Exception:
+        return []
+    return [
+        ("compile_cache_hits_total", c.get("hits", 0), "counter",
+         "compiled-program registry lookups served from cache "
+         "(no XLA compile)", None),
+        ("compile_cache_misses_total", c.get("misses", 0), "counter",
+         "compiled-program registry misses that lowered+compiled "
+         "(includes prewarms)", None),
+        ("compile_cache_prewarmed_total", c.get("prewarmed", 0), "counter",
+         "registry entries compiled ahead of traffic from a prewarm "
+         "manifest", None),
+        ("compile_cache_entries", c.get("entries", 0), "gauge",
+         "live executables in the compiled-program registry "
+         "(generation GC retires them)", None),
+    ]
+
+
 _VERDICT_CODE = {"hbm-bound": 0.0, "compute-bound": 1.0, "overhead": -1.0}
 
 
@@ -334,6 +362,7 @@ def serve_exposition(snapshot: dict, prefix: str = "tpuic_serve",
     rows.extend(admission_rows(snapshot, admission))
     rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
+    rows.extend(compile_cache_rows())
     return render(rows, prefix=prefix)
 
 
@@ -577,6 +606,7 @@ def train_exposition(report: dict, steptime: Optional[dict] = None,
     rows.extend(profile_rows(profile))
     rows.extend(memory_rows(memory))
     rows.extend(slo_rows(slo))
+    rows.extend(compile_cache_rows())
     return render(rows, prefix=prefix)
 
 

@@ -237,7 +237,10 @@ def serve_workload(requests: int = 48, *, size: int = 16,
         max_wait_ms=max_wait_ms, queue_size=max(64, requests),
         variants=variants)
     try:
-        engine.warmup()
+        # Shared warmup helper (tpuic/compiled/) — same registry-backed
+        # AOT path bench_serve.py warms through.
+        from tpuic.compiled import warm_engine
+        warm_engine(engine)
 
         def run(rate: float, dtype=None) -> dict:
             # The shared bench/gate driver (tpuic/serve/loadgen.py): the

@@ -313,21 +313,203 @@ class Trainer:
                                      - self.train_loader.resident_bytes))
         return global_batch
 
+    def _step_program_keys(self):
+        """Registry keys of THE train/eval step programs for the current
+        geometry (tpuic.compiled, docs/performance.md "Compiled-program
+        registry").  The key pins everything the built step closes over
+        — optimizer config (schedule params, guard, loss scale, class
+        weights, ema), seed, eval flags, sharding flags, the donation
+        policy verdict — plus the loader geometry the schedule was
+        derived from, the mesh signature, and the batch avals.  An
+        elastic reform back to a previously-seen extent therefore HITS
+        (aval-identical executables reused instead of re-jitting); any
+        geometry/config change misses and the superseded key is evicted."""
+        import dataclasses as _dc
+
+        from tpuic.compiled import ProgramKey, donation_allowed, stable_crc
+        cfg = self.cfg
+        d = cfg.data
+        steps = max(1, self.train_loader.steps_per_epoch())
+        global_batch = self.train_loader.global_batch
+        mesh_sig = (tuple((str(a), int(n)) for a, n in
+                          self.mesh.shape.items())
+                    if self.mesh.size > 1 else ())
+        cfg_crc = stable_crc({
+            "optim": _dc.asdict(cfg.optim), "model": _dc.asdict(self.mcfg),
+            "mesh_cfg": _dc.asdict(cfg.mesh), "seed": cfg.run.seed,
+            "epochs": cfg.run.epochs, "steps_per_epoch": steps,
+            "collect": cfg.run.collect_misclassified,
+            "per_class": cfg.run.per_class_metrics,
+            "donate": donation_allowed(
+                guard_active=bool(cfg.optim.skip_nonfinite)),
+        })
+        shapes = ((global_batch, d.resize_size, d.resize_size, 3), cfg_crc)
+        return tuple(
+            ProgramKey(model=f"train:{self.mcfg.name}:{kind}",
+                       shapes=shapes, mesh=mesh_sig, dtype=self.mcfg.dtype)
+            for kind in ("step", "eval"))
+
     def _build_steps(self) -> None:
-        """(Re-)jit the train/eval steps for the CURRENT mesh, schedule,
-        and state sharding — shared by ``__init__`` and the elastic
-        re-form."""
+        """(Re-)build the train/eval steps for the CURRENT mesh,
+        schedule, and state sharding — shared by ``__init__`` and the
+        elastic re-form — through the compiled-program registry
+        (tpuic/compiled): a reform whose geometry matches an existing
+        key reuses the aval-identical jitted step (and its warm XLA
+        cache) instead of re-jitting, a changed geometry builds fresh
+        and evicts the pre-reform entries."""
+        from tpuic.compiled import registry as _registry
         cfg = self.cfg
         step_mesh = self.mesh if self.mesh.size > 1 else None
-        self.train_step = make_train_step(cfg.optim, self.mcfg, step_mesh,
-                                          lr_schedule=self.schedule,
-                                          seed=cfg.run.seed,
-                                          state_sharding=self.state_sharding)
-        self.eval_step = make_eval_step(
-            cfg.optim, self.mcfg, step_mesh,
-            state_sharding=self.state_sharding,
-            per_sample=cfg.run.collect_misclassified,
-            per_class=cfg.run.per_class_metrics)
+        train_key, eval_key = self._step_program_keys()
+        self.train_step = _registry.get_or_compile(
+            train_key,
+            lambda: make_train_step(cfg.optim, self.mcfg, step_mesh,
+                                    lr_schedule=self.schedule,
+                                    seed=cfg.run.seed,
+                                    state_sharding=self.state_sharding),
+        ).executable
+        self.eval_step = _registry.get_or_compile(
+            eval_key,
+            lambda: make_eval_step(
+                cfg.optim, self.mcfg, step_mesh,
+                state_sharding=self.state_sharding,
+                per_sample=cfg.run.collect_misclassified,
+                per_class=cfg.run.per_class_metrics),
+        ).executable
+        # Pre-reform GC: a superseded geometry's step entries can never
+        # run again in this process.
+        for old in getattr(self, "_step_keys", ()):
+            if old not in (train_key, eval_key):
+                _registry.evict(old)
+        self._step_keys = (train_key, eval_key)
+        # Prewarm manifest (docs/performance.md): when the supervisor —
+        # or any caller — exported TPUIC_COMPILE_MANIFEST, persist the
+        # keys this process compiled so the NEXT process (a restarted
+        # gang member) prewarms them up front.  ``_manifest_preexisting``
+        # (first call only) records whether a previous life already left
+        # a manifest behind — that is what gates the restart-side
+        # prewarm in fit().
+        mpath = os.environ.get("TPUIC_COMPILE_MANIFEST", "")
+        if mpath:
+            if not hasattr(self, "_manifest_preexisting"):
+                self._manifest_preexisting = os.path.exists(mpath)
+            try:
+                _registry.write_manifest(mpath)
+            except OSError as e:
+                host0_print(f"[compiled] could not write prewarm "
+                            f"manifest {mpath}: {e}")
+
+    def prewarm(self, manifest_path: Optional[str] = None) -> dict:
+        """Compile-and-execute every program this run's steady state
+        needs BEFORE the first training step (docs/performance.md,
+        "Compiled-program registry") — the restart path that turns
+        first-step compile stalls into up-front prewarm time, measured
+        in perf/resume_cache_proof.json and checker-asserted (zero
+        steady-state compiles after prewarm) in the CI prewarm smoke.
+
+        One real batch is pulled from each loader (the same batch fit()
+        will see first — the epoch permutation and augment streams are
+        position-keyed and stateless, so nothing is consumed or
+        perturbed) and run through the train step against a THROWAWAY
+        copy of the state (the step is functional and the copy absorbs
+        donation) and through the eval step directly.  Executing — not
+        just lowering — is what populates the jit caches and forces the
+        backend compiles (disk reads when the persistent XLA cache is
+        warm), so the subsequent fit dispatches with zero compiles.
+
+        ``manifest_path`` names a prewarm manifest to cross-check: a
+        corrupt manifest raises :class:`tpuic.compiled.ManifestError`
+        (refusal — never prewarm from a torn file); a manifest that
+        does not list this run's keys is reported but does not block
+        (the geometry is local knowledge; the manifest is the fleet's
+        memory of it)."""
+        from tpuic.compiled import ProgramKey, load_manifest
+        from tpuic.compiled import registry as _registry
+        t0 = time.perf_counter()
+        listed = None
+        if manifest_path:
+            listed = {ProgramKey.from_dict(e["key"])
+                      for e in load_manifest(manifest_path)}
+        keys = getattr(self, "_step_keys", ())
+        covered = (None if listed is None
+                   else sum(1 for k in keys if k in listed))
+        it = self.train_loader.epoch(self.start_epoch,
+                                     start_step=self.start_step)
+        try:
+            batch = next(it)
+        finally:
+            it.close()
+        fbatch = {k: batch[k] for k in ("image", "label", "mask")}
+        # Donation-safe copy: the guard-off path donates the state
+        # argument, so the real self.state must never be passed here.
+        # The copy must be SIGNATURE-FAITHFUL leaf by leaf — a restored
+        # state mixes numpy leaves with committed/uncommitted jax
+        # Arrays, and coercing a numpy leaf to a jax Array changes the
+        # pjit call signature: fit's first step would then backend-
+        # compile a second executable (no retrace, so invisible to
+        # trace counters) and the prewarm would not be compile-flat.
+        # jnp.copy preserves sharding and committed-ness for jax
+        # Arrays; numpy stays numpy; host scalars are immutable.
+        import jax.numpy as jnp
+
+        def _leaf_copy(x):
+            if isinstance(x, jax.Array):
+                return jnp.copy(x)
+            if isinstance(x, np.ndarray):
+                return np.copy(x)
+            return x
+
+        state_copy = jax.tree_util.tree_map(_leaf_copy, self.state)
+        # TWO train-step executions, because a resumed run dispatches
+        # under TWO distinct program signatures and both must be warm:
+        #  1. the RESTORED signature — a checkpoint-restored state mixes
+        #     numpy and uncommitted-jax scalar leaves (step, skip_count),
+        #     which pjit resolves to unspecified input shardings; fit's
+        #     first step runs under this signature, and
+        #  2. the STEADY-STATE signature — every later step passes the
+        #     previous step's output, whose leaves are all committed jax
+        #     Arrays, so the same avals resolve to concrete shardings: a
+        #     different lowering key and a different executable.
+        # Warming only (1) leaves fit's SECOND step to backend-compile
+        # (the stall moves one step later instead of disappearing).
+        # Feeding call 1's output state into call 2 reproduces (2)
+        # exactly; both calls run against throwaway state (donation-safe).
+        out_state, m = self.train_step(state_copy, fbatch)
+        jax.block_until_ready(m["loss"])
+        out2_state, m2 = self.train_step(out_state, fbatch)
+        jax.block_until_ready(m2["loss"])
+        del out_state, state_copy
+        vit = self.val_loader.epoch(0)
+        try:
+            vbatch = next(vit)
+        finally:
+            vit.close()
+        vfbatch = {k: vbatch[k] for k in ("image", "label", "mask")}
+        # Same two-signature rule for eval: fit's epoch-end eval sees the
+        # post-step (all-committed) state; an eval before any step (a
+        # resume landing exactly on an epoch boundary) sees the restored
+        # one. keep_unused DCE usually collapses the two eval signatures
+        # into one, but that is a jaxpr property, not a contract.
+        em = self.eval_step(out2_state, vfbatch)
+        jax.block_until_ready(em["count"])
+        em2 = self.eval_step(self.state, vfbatch)
+        jax.block_until_ready(em2["count"])
+        del out2_state
+        for k in keys:
+            _registry.mark_prewarmed(k)
+        prewarm_s = time.perf_counter() - t0
+        out = {"prewarm_s": round(prewarm_s, 3), "programs": len(keys),
+               "manifest_listed": covered}
+        if covered is not None and covered < len(keys):
+            host0_print(f"[compiled] prewarm manifest lists {covered}/"
+                        f"{len(keys)} of this run's step programs "
+                        f"(geometry changed since it was written)")
+        host0_print(f"[compiled] prewarmed {len(keys)} step programs in "
+                    f"{prewarm_s:.1f}s")
+        _tm_publish("compile_cache", action="prewarm_done",
+                    programs=len(keys), manifest_listed=covered,
+                    duration_s=round(prewarm_s, 3))
+        return out
 
     def _loader_geometry(self):
         """(global_batch, seed, n_samples) — everything the epoch
@@ -930,6 +1112,22 @@ class Trainer:
             _tm_publish("restart", restart=count,
                         downtime_s=round(downtime_s, 3),
                         epoch=self.start_epoch, step_in_epoch=self.start_step)
+        # Manifest-driven restart prewarm (docs/performance.md,
+        # "Compiled-program registry"): when TPUIC_COMPILE_MANIFEST
+        # names a manifest a PREVIOUS life left behind, compile-and-run
+        # every step program now — against the persistent XLA cache —
+        # so the steady state below dispatches with zero compiles.  A
+        # corrupt manifest is refused loudly and training proceeds
+        # unwarmed (correctness never depended on the prewarm).
+        mpath = os.environ.get("TPUIC_COMPILE_MANIFEST", "")
+        if mpath and getattr(self, "_manifest_preexisting", False):
+            from tpuic.compiled import ManifestError
+            try:
+                self.prewarm(mpath)
+            except ManifestError as e:
+                host0_print(f"[compiled] refusing prewarm manifest: {e}")
+            except FileNotFoundError:
+                pass
         self._steps_exhausted = False
         try:
             epoch = self.start_epoch

@@ -164,19 +164,20 @@ def make_train_step(optim_cfg: OptimConfig, model_cfg: ModelConfig,
     compute_dtype = resolve_compute_dtype(model_cfg)
     cast_dtype = jnp.bfloat16 if compute_dtype == "bf16" else None
     loss_scale = float(optim_cfg.loss_scale or 1.0)
-    if (donate and optim_cfg.skip_nonfinite
-            and getattr(jax.config, "jax_compilation_cache_dir", None)
-            and jax.default_backend() == "cpu"):
-        # The guard's skip path aliases donated inputs straight to outputs
-        # (state passes through unchanged). Executables DESERIALIZED from
-        # the persistent compilation cache mishandle that aliasing on this
-        # container's jax 0.4.37 CPU backend — measured as both silent
-        # buffer corruption (NaN loss on finite data after a restore) and
-        # nondeterministic SIGSEGV/SIGABRT in dispatch; cache+donate+
-        # guard is the exact trigger, any two of the three are fine.
-        # Scoped to the CPU backend where it was measured: TPU runs (and
-        # any run without a persistent cache — train.py configures none)
-        # keep donation.
+    # The cpu+cache+guard donation-disable rule lives in ONE place now:
+    # tpuic.compiled.donation_allowed (docs/performance.md, "Compiled-
+    # program registry").  The guard's skip path aliases donated inputs
+    # straight to outputs (state passes through unchanged); executables
+    # DESERIALIZED from the persistent compilation cache mishandle that
+    # aliasing on this container's jax 0.4.37 CPU backend — silent
+    # buffer corruption (NaN loss on finite data after a restore) and
+    # nondeterministic SIGSEGV/SIGABRT in dispatch.  Cache+donate+guard
+    # is the exact trigger; any two of the three are fine, so TPU runs
+    # (and any run without a persistent cache — train.py configures
+    # none) keep donation.
+    from tpuic.compiled import donation_allowed
+    if donate and not donation_allowed(
+            guard_active=bool(optim_cfg.skip_nonfinite)):
         warnings.warn(
             "skip_nonfinite guard + persistent compilation cache: "
             "disabling train-state donation to avoid a known "
