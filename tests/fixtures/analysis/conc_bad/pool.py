@@ -1,0 +1,20 @@
+"""Seeded CONC101 violation: two methods take the same pair of locks in
+opposite orders — two threads interleaving fwd() and rev() deadlock."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_lock = threading.Lock()
+        self._free_lock = threading.Lock()
+
+    def fwd(self):
+        with self._alloc_lock:
+            with self._free_lock:
+                return 1
+
+    def rev(self):
+        with self._free_lock:
+            with self._alloc_lock:
+                return 2

@@ -1,0 +1,17 @@
+"""Seeded CONC102 violation: the signal handler acquires a project lock
+— the signal may have interrupted the very frame that holds it."""
+
+import signal
+import threading
+
+_lock = threading.Lock()
+_ring = []
+
+
+def _on_term(signum, frame):
+    with _lock:
+        _ring.append(signum)
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

@@ -1,0 +1,8 @@
+"""Seeded CTR101 violations: 'mystery' is registered but has no schema
+row in docs/observability.md; 'rogue' is published but not registered."""
+
+EVENT_KINDS = ("step", "mystery")
+
+
+def emit(bus):
+    bus.publish("rogue", x=1)

@@ -1,0 +1,10 @@
+"""Seeded SPMD101 violation: a collective under rank-gated control flow
+executes on some processes and not others — the fleet hangs."""
+
+import jax
+
+
+def reduce_loss(x, rank):
+    if rank == 0:
+        return jax.lax.psum(x, "batch")
+    return x

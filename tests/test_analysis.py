@@ -13,9 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuic.analysis import (Finding, Severity, RULES, fingerprint,
-                            lint_source, lint_paths, load_baseline,
-                            new_findings, write_baseline)
+from tpuic.analysis import (Finding, Severity, RULES, analyze_paths,
+                            fingerprint, lint_source, lint_paths,
+                            load_baseline, new_findings, write_baseline)
 from tpuic.analysis import runtime as contracts
 from tpuic.analysis.__main__ import main as lint_main
 
@@ -281,7 +281,7 @@ def test_rule_detects_bad_and_passes_good(rule, path, bad, good):
 
 
 def test_every_rule_has_a_fixture_pair():
-    covered = {c[0] for c in CASES}
+    covered = {c[0] for c in CASES} | {c[0] for c in PROJECT_CASES}
     assert covered == set(RULES) - {"TPU000"}, \
         f"rules without fixtures: {set(RULES) - covered - {'TPU000'}}"
 
@@ -302,6 +302,419 @@ def test_findings_carry_severity_line_and_anchor():
 def test_syntax_error_reported_not_raised():
     fs = _lint("def f(:\n")
     assert [f.rule for f in fs] == ["TPU000"]
+
+
+# -- project passes: paired good/bad fixture TREES ---------------------------
+# Each case is (rule, bad tree, good tree) where a tree maps relative
+# path -> source.  Project rules need whole trees (cross-function,
+# cross-file, code-vs-docs), so these run through analyze_paths on a
+# tmp dir rather than lint_source.
+
+_CONC101_BAD = {"pool.py": """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    return 2
+    """}
+
+_CONC101_GOOD = {"pool.py": """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def rev(self):
+            with self._a:
+                with self._b:
+                    return 2
+    """}
+
+_CONC102_BAD = {"sig.py": """
+    import signal
+    import threading
+
+    _lock = threading.Lock()
+    _ring = []
+
+    def _on_term(signum, frame):
+        with _lock:
+            _ring.append(signum)
+
+    def install():
+        signal.signal(signal.SIGTERM, _on_term)
+    """}
+
+# The FlightRecorder design (tpuic/telemetry/flight.py): the handler
+# snapshots the ring lock-free (list() is one C call) and writes a
+# LOCAL file handle — no project lock, no bus, no shared fh.
+_CONC102_GOOD = {"sig.py": """
+    import signal
+    import threading
+
+    class Recorder:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ring = []
+
+        def record(self, item):
+            with self._lock:
+                self._ring.append(item)
+
+        def dump(self, path):
+            snap = list(self._ring)
+            with open(path, "w") as fh:
+                fh.write(repr(snap))
+
+        def install(self):
+            def _on_quit(signum, frame):
+                self.dump("/tmp/flight.jsonl")
+            signal.signal(signal.SIGQUIT, _on_quit)
+    """}
+
+_CONC103_BAD = {"spawn.py": """
+    import threading
+
+    def gather():
+        results = []
+
+        def worker():
+            results.append(1)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        results.append(2)
+        return t
+    """}
+
+_CONC103_GOOD = {"spawn.py": """
+    import threading
+
+    def gather():
+        results = []
+        mu = threading.Lock()
+
+        def worker():
+            with mu:
+                results.append(1)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        with mu:
+            results.append(2)
+        return t
+    """}
+
+# The ISSUE's canonical SPMD101 shape: a collective under rank-gated
+# control flow executes on some chips and not others -> fleet hang.
+_SPMD101_BAD = {"reduce.py": """
+    import jax
+
+    def reduce_loss(x, rank):
+        if rank == 0:
+            return jax.lax.psum(x, "batch")
+        return x
+    """}
+
+_SPMD101_GOOD = {"reduce.py": """
+    import jax
+
+    def reduce_loss(x, rank):
+        y = jax.lax.psum(x, "batch")
+        if rank == 0:
+            print(y)
+        return y
+    """}
+
+_SPMD102_BAD = {"order.py": """
+    import jax
+
+    def fwd(x):
+        y = jax.lax.psum(x, "data")
+        return jax.lax.pmean(y, "data")
+
+    def rev(x):
+        y = jax.lax.pmean(x, "data")
+        return jax.lax.psum(y, "data")
+    """}
+
+_SPMD102_GOOD = {"order.py": """
+    import jax
+
+    def fwd(x):
+        y = jax.lax.psum(x, "data")
+        return jax.lax.pmean(y, "data")
+
+    def rev(x):
+        y = jax.lax.psum(x, "data")
+        return jax.lax.pmean(y, "data")
+    """}
+
+_CTR_DOC_OK = """
+| kind | emitter | data |
+|------|---------|------|
+| `step` | loop | `step` |
+| `mystery` | loop | `why` |
+"""
+
+_CTR101_BAD = {
+    "tpuic/telemetry/events.py": """
+        EVENT_KINDS = ("step", "mystery")
+
+        def emit(bus):
+            bus.publish("rogue", x=1)
+        """,
+    "docs/observability.md": "| `step` | loop | `step` |\n",
+}
+
+_CTR101_GOOD = {
+    "tpuic/telemetry/events.py": """
+        EVENT_KINDS = ("step", "mystery")
+
+        def emit(bus):
+            bus.publish("step", x=1)
+        """,
+    "docs/observability.md": _CTR_DOC_OK,
+}
+
+_CTR102_BAD = {
+    "tpuic/telemetry/prom.py": """
+        def rows():
+            return [("foo_total", 1, "counter", "help", None)]
+        """,
+    "docs/observability.md": "nothing documented here\n",
+}
+
+_CTR102_GOOD = {
+    "tpuic/telemetry/prom.py": """
+        def rows():
+            return [("foo_total", 1, "counter", "help", None)]
+        """,
+    "docs/observability.md": "- `foo_total` — a documented counter\n",
+}
+
+_CTR103_BAD = {
+    "tpuic/runtime/supervisor.py": """
+        import sys
+
+        EXIT_OK = 0
+        EXIT_BAD = 7
+
+        def die():
+            sys.exit(7)
+        """,
+    "docs/robustness.md": "the supervisor exits cleanly\n",
+}
+
+_CTR103_GOOD = {
+    "tpuic/runtime/supervisor.py": """
+        import sys
+
+        EXIT_OK = 0
+        EXIT_BAD = 7
+
+        def die():
+            sys.exit(EXIT_BAD)
+        """,
+    "docs/robustness.md": "gives up with exit **7** (`EXIT_BAD`)\n",
+}
+
+PROJECT_CASES = [
+    ("CONC101", _CONC101_BAD, _CONC101_GOOD),
+    ("CONC102", _CONC102_BAD, _CONC102_GOOD),
+    ("CONC103", _CONC103_BAD, _CONC103_GOOD),
+    ("SPMD101", _SPMD101_BAD, _SPMD101_GOOD),
+    ("SPMD102", _SPMD102_BAD, _SPMD102_GOOD),
+    ("CTR101", _CTR101_BAD, _CTR101_GOOD),
+    ("CTR102", _CTR102_BAD, _CTR102_GOOD),
+    ("CTR103", _CTR103_BAD, _CTR103_GOOD),
+]
+
+
+def _analyze_tree(root, files, passes=("conc", "spmd", "ctr")):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    findings, _ = analyze_paths([str(root)], passes=passes)
+    return findings
+
+
+@pytest.mark.parametrize("rule,bad,good", PROJECT_CASES,
+                         ids=[c[0] for c in PROJECT_CASES])
+def test_project_rule_detects_bad_and_passes_good(rule, bad, good,
+                                                  tmp_path):
+    bad_rules = _rules_of(_analyze_tree(tmp_path / "bad", bad))
+    good_rules = _rules_of(_analyze_tree(tmp_path / "good", good))
+    assert rule in bad_rules, f"{rule} missed its bad tree"
+    assert rule not in good_rules, \
+        f"{rule} false-positived on its good tree ({good_rules})"
+
+
+def test_project_findings_carry_family_and_fkey(tmp_path):
+    findings = _analyze_tree(tmp_path, _CONC101_BAD, passes=("conc",))
+    (f,) = [f for f in findings if f.rule == "CONC101"]
+    assert f.family == "conc"
+    assert f.fkey.startswith("conc101:") and "->" in f.fkey
+    # Lint findings stay in the 'lint' family.
+    assert Finding("TPU501", Severity.WARNING, "a.py", 1, "m").family \
+        == "lint"
+
+
+def test_def_line_allowlist_covers_project_rules(tmp_path):
+    """A '# tpuic-ok: CONC102 why' on the handler's def line allowlists
+    the whole signal path body — same mechanism as the lint rules."""
+    files = {"sig.py": """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+        _ring = []
+
+        def _on_term(signum, frame):  # tpuic-ok: CONC102 ring is ours
+            with _lock:
+                _ring.append(signum)
+
+        def install():
+            signal.signal(signal.SIGTERM, _on_term)
+        """}
+    assert "CONC102" not in _rules_of(_analyze_tree(tmp_path, files))
+
+
+def test_spmd101_flags_rank_gated_early_exit(tmp_path):
+    """The second SPMD101 form: a rank-tainted early return ABOVE a
+    collective diverges the fleet just as surely as a gated call."""
+    files = {"early.py": """
+        import os
+        import jax
+
+        def step(x):
+            if os.environ.get("TPUIC_FLEET_RANK") == "0":
+                return x
+            return jax.lax.psum(x, "batch")
+        """}
+    assert "SPMD101" in _rules_of(_analyze_tree(tmp_path, files))
+
+
+def test_spmd_world_size_guard_not_tainted(tmp_path):
+    """'ranks' (world size) is the same value everywhere — a ranks > 1
+    guard is NOT rank-divergent (precision regression guard)."""
+    files = {"guard.py": """
+        import jax
+
+        def maybe_reduce(x, ranks):
+            if ranks > 1:
+                return jax.lax.psum(x, "batch")
+            return x
+        """}
+    assert "SPMD101" not in _rules_of(_analyze_tree(tmp_path, files))
+
+
+# -- CTR drift, both directions, on mutated copies of the REAL artifacts -----
+def _real(rel):
+    with open(os.path.join(_REPO, rel), encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _ctr_tree(root, events=None, prom=None, obs_doc=None):
+    (root / "tpuic" / "telemetry").mkdir(parents=True, exist_ok=True)
+    (root / "docs").mkdir(exist_ok=True)
+    if events is not None:
+        (root / "tpuic/telemetry/events.py").write_text(events)
+    if prom is not None:
+        (root / "tpuic/telemetry/prom.py").write_text(prom)
+    (root / "docs/observability.md").write_text(
+        obs_doc if obs_doc is not None else _real("docs/observability.md"))
+    findings, _ = analyze_paths([str(root)], passes=("ctr",))
+    return findings
+
+
+def test_ctr_real_artifact_copies_are_clean(tmp_path):
+    """Unmutated copies of the committed events.py/prom.py/docs carry
+    zero CTR findings — the committed tree IS the good fixture."""
+    fs = _ctr_tree(tmp_path, events=_real("tpuic/telemetry/events.py"),
+                   prom=_real("tpuic/telemetry/prom.py"))
+    assert [f.render() for f in fs] == []
+
+
+def test_ctr101_drift_code_ahead_of_docs(tmp_path):
+    """Register a new kind without a schema row -> CTR101 names it."""
+    events = _real("tpuic/telemetry/events.py").replace(
+        '"compile_cache")', '"compile_cache", "brand_new_kind")')
+    assert '"brand_new_kind"' in events  # the mutation landed
+    fs = _ctr_tree(tmp_path, events=events)
+    assert any(f.rule == "CTR101" and "brand_new_kind" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_ctr101_drift_publish_ahead_of_registry(tmp_path):
+    """Publish an unregistered kind -> CTR101 flags the call site."""
+    events = _real("tpuic/telemetry/events.py") + (
+        "\n\ndef _rogue_emitter(bus):\n"
+        "    bus.publish(\"undeclared_kind\", x=1)\n")
+    fs = _ctr_tree(tmp_path, events=events)
+    assert any(f.rule == "CTR101" and "undeclared_kind" in f.message
+               and "not registered" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_ctr102_drift_new_row_undocumented(tmp_path):
+    """Emit a new prom row without a doc mention -> CTR102 names it."""
+    prom = _real("tpuic/telemetry/prom.py") + (
+        "\n\ndef _extra_rows():\n"
+        "    return [(\"undocumented_widget_total\", 1, \"counter\","
+        " \"h\", None)]\n")
+    fs = _ctr_tree(tmp_path, prom=prom)
+    assert any(f.rule == "CTR102"
+               and "undocumented_widget_total" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_ctr102_doc_row_for_removed_metric_goes_stale(tmp_path):
+    """The reverse direction rides the baseline: a doc mention with no
+    emitting row produces no finding (docs may describe history), but a
+    previously-baselined CTR102 entry for it reports stale — so prune
+    happens through --write-baseline, not silence."""
+    fs = _ctr_tree(tmp_path, prom="def rows():\n    return []\n")
+    assert not any(f.rule == "CTR102" for f in fs)
+
+
+def test_ctr103_duplicate_values_and_raw_literals(tmp_path):
+    files = {
+        "tpuic/runtime/supervisor.py": """
+            import sys
+
+            EXIT_PREEMPTED = 43
+            EXIT_POISON = 43
+
+            def die():
+                sys.exit(43)
+            """,
+        "docs/robustness.md":
+            "exit **43** (`EXIT_PREEMPTED`, `EXIT_POISON`)\n",
+    }
+    msgs = [f.message for f in _analyze_tree(tmp_path, files,
+                                             passes=("ctr",))]
+    assert any("share the value 43" in m for m in msgs), msgs
+    assert any("raw exit literal 43" in m for m in msgs), msgs
 
 
 # -- jit-context detection ---------------------------------------------------
@@ -456,6 +869,34 @@ def test_fingerprint_invariant_to_invocation_path_style():
     assert fingerprint(rel) == fingerprint(abs_)
 
 
+def test_fkey_fingerprint_survives_relocation_and_reanchoring():
+    """A project-level finding (lock cycle spanning files) keys on its
+    structural edge set: moving the code or re-anchoring the line must
+    not churn the baseline; changing the cycle must."""
+    fk = "conc101:m::A._a->m::A._b;m::A._b->m::A._a"
+    a = Finding("CONC101", Severity.ERROR, "x.py", 10, "m",
+                anchor="with self._a:", fkey=fk)
+    b = Finding("CONC101", Severity.ERROR, "y.py", 99, "m",
+                anchor="with self._b:", fkey=fk)
+    assert fingerprint(a) == fingerprint(b)
+    c = Finding("CONC101", Severity.ERROR, "x.py", 10, "m",
+                anchor="with self._a:",
+                fkey="conc101:m::A._a->m::A._c;m::A._c->m::A._a")
+    assert fingerprint(a) != fingerprint(c)
+
+
+def test_write_baseline_records_fkey(tmp_path):
+    base = str(tmp_path / "b.json")
+    f = Finding("CTR102", Severity.WARNING, "p.py", 1, "m",
+                fkey="ctr102:foo_total")
+    write_baseline(base, [f])
+    with open(base) as fh:
+        (entry,) = json.load(fh)["findings"]
+    assert entry["fkey"] == "ctr102:foo_total"
+    fresh, stale = new_findings([f], load_baseline(base))
+    assert fresh == [] and stale == 0
+
+
 def test_baseline_roundtrip_and_gating(tmp_path):
     base = str(tmp_path / "baseline.json")
     legacy = [_mk_finding(), _mk_finding(path="b.py", anchor="import re")]
@@ -541,6 +982,41 @@ def test_cli_json_and_select_and_list_rules(tmp_path, capsys):
     assert lint_main(["--list-rules"]) == 0
     assert "TPU202" in capsys.readouterr().out
     assert lint_main([str(pkg), "--select", "NOPE"]) == 2
+
+
+def test_cli_passes_flag(tmp_path, capsys):
+    """--passes restricts the pass set; an unknown pass is a usage
+    error; the JSON payload carries the finding's family."""
+    for rel, src in _CONC101_BAD.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src))
+    # conc pass on: the cycle fails the gate
+    assert lint_main([str(tmp_path), "--no-baseline",
+                      "--passes", "conc", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["rule"] == "CONC101"
+    assert payload[0]["family"] == "conc"
+    assert payload[0]["fkey"].startswith("conc101:")
+    # lint-only: the same tree is clean (no per-file footguns in it)
+    assert lint_main([str(tmp_path), "--no-baseline",
+                      "--passes", "lint"]) == 0
+    assert lint_main([str(tmp_path), "--passes", "nope"]) == 2
+
+
+def test_ci_seeded_fixture_trees_fire(capsys):
+    """The in-process mirror of CI's bidirectional-proof step: the
+    committed seeded-violation trees (tests/fixtures/analysis/) must
+    fail with exactly the expected families' rule ids."""
+    fix = os.path.join(_REPO, "tests", "fixtures", "analysis")
+
+    def fired(tree, passes):
+        rc = lint_main([os.path.join(fix, tree), "--no-baseline",
+                        "--json", "--passes", passes])
+        assert rc == 1, f"{tree} unexpectedly clean"
+        return {f["rule"] for f in json.loads(capsys.readouterr().out)}
+
+    assert {"CONC101", "CONC102"} <= fired("conc_bad", "conc")
+    assert "SPMD101" in fired("spmd_bad", "spmd")
+    assert "CTR101" in fired("ctr_bad", "ctr")
 
 
 def test_committed_tree_is_clean_against_committed_baseline():
@@ -661,6 +1137,125 @@ def test_compiles_flat_marker_wraps_test():
 def test_device_gets_fixture(device_gets):
     jax.device_get(jnp.ones((2,)))
     assert device_gets.count == 1
+
+
+# -- LockOrderWatch: the dynamic half of CONC101 ------------------------------
+import threading  # noqa: E402  (used by the lock-order tests only)
+
+
+def test_lock_order_watch_records_creation_site_named_edges():
+    with contracts.lock_order_watch() as w:
+        outer_lock = threading.Lock()
+        inner_lock = threading.Lock()
+        with outer_lock:
+            with inner_lock:
+                pass
+    mod = __name__
+    assert (f"{mod}::outer_lock", f"{mod}::inner_lock") in w.edges
+
+
+def test_lock_order_watch_hard_fails_on_observed_inversion():
+    w = contracts.LockOrderWatch()
+    w.install()
+    try:
+        first_lock = threading.Lock()
+        second_lock = threading.Lock()
+        with first_lock:
+            with second_lock:
+                pass
+        with second_lock:
+            with first_lock:
+                pass
+    finally:
+        w.uninstall()
+    with pytest.raises(contracts.LockOrderViolation,
+                       match="closes a cycle"):
+        w.check()
+
+
+def test_lock_order_watch_reports_stale_static_edges():
+    w = contracts.LockOrderWatch()
+    w.install()
+    try:
+        only_lock = threading.Lock()
+        with only_lock:
+            pass
+    finally:
+        w.uninstall()
+    stale = w.check({("m::C.only_lock", "m::C.other_lock")})
+    assert stale and "never observed" in stale[0]
+    # an exercised static edge is NOT stale
+    w2 = contracts.LockOrderWatch()
+    w2.install()
+    try:
+        alpha_lock = threading.Lock()
+        beta_lock = threading.Lock()
+        with alpha_lock:
+            with beta_lock:
+                pass
+    finally:
+        w2.uninstall()
+    assert w2.check({("m::C.alpha_lock", "m::C.beta_lock")}) == []
+
+
+def test_lock_order_watch_condition_compat_and_uninstall():
+    real_factory = threading.Lock
+    w = contracts.LockOrderWatch()
+    w.install()
+    try:
+        guard_lock = threading.RLock()
+        cond = threading.Condition(guard_lock)
+        with cond:
+            cond.notify_all()
+    finally:
+        w.uninstall()
+    w.check()
+    assert threading.Lock is real_factory  # patch fully reverted
+
+
+def test_lock_order_watch_cross_thread_edges():
+    """Edges are per-thread held-stacks: a second thread taking the
+    same nesting order adds no inversion; opposite order does."""
+    w = contracts.LockOrderWatch()
+    w.install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def other():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        with lock_a:
+            with lock_b:
+                pass
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    finally:
+        w.uninstall()
+    with pytest.raises(contracts.LockOrderViolation):
+        w.check()
+
+
+def test_static_lock_edges_cross_check_on_real_tree(lock_order_watch):
+    """The runtime/static cross-check wired end to end: drive the
+    serve-engine swap-lock nesting the static graph claims, then
+    check() — the driven edge must not be stale and no inversion may
+    appear.  (Locks are created inside the fixture's watch window.)"""
+    static = contracts.static_lock_edges([os.path.join(_REPO, "tpuic")])
+    assert static, "static CONC101 graph unexpectedly empty"
+    # Recreate the real nesting: InferenceEngine._swap_lock holds while
+    # ProgramRegistry._lock is acquired (engine.swap -> registry).
+    _swap_lock = threading.Lock()
+    _lock = threading.Lock()
+    with _swap_lock:
+        with _lock:
+            pass
+    # Both real edges share the (_swap_lock, _lock) attr-name tail
+    # pair, so driving it once leaves nothing stale.
+    assert lock_order_watch.check(static) == []
 
 
 def test_compile_watch_fixture(compile_watch):

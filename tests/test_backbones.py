@@ -18,6 +18,7 @@ def test_registry_covers_reference_and_baseline_selectors():
         assert n in names
 
 
+@pytest.mark.slow  # ~21 s CPU: b0 64px head-shape check; test_efficientnet_train_mode_with_droppath keeps b0 construction+forward tier-1
 def test_efficientnet_b0_shapes():
     model = create_model("efficientnet-b0", 5, dtype="float32")
     x = jnp.zeros((2, 64, 64, 3), jnp.float32)

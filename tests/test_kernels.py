@@ -302,9 +302,12 @@ class TestFusedConvBNRelu:
         assert out.dtype == jnp.bfloat16
         assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
 
-    @pytest.mark.parametrize("name,size", [("resnet18-cifar", 32),
-                                           ("resnet50", 64),
-                                           ("resnet50-s2d", 64)])
+    @pytest.mark.parametrize("name,size", [
+        ("resnet18-cifar", 32),
+        # ~18 s CPU: plain resnet50 parity; the cifar and s2d params keep
+        # fused-inference parity tier-1 for both conv layouts.
+        pytest.param("resnet50", 64, marks=pytest.mark.slow),
+        ("resnet50-s2d", 64)])
     def test_resnet_fused_inference_parity(self, name, size):
         """The model-zoo wiring (ModelConfig.fused_conv_bn): identical
         parameter structure (checkpoints interchangeable), inference

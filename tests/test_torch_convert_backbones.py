@@ -113,6 +113,7 @@ def test_efficientnet_forward_parity():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # ~41 s CPU: full Inception export roundtrip; efficientnet/vit/orbax-CLI roundtrips keep the export family tier-1, test_inception_aux_conversion_shapes keeps inception conversion tier-1
 def test_export_inception_roundtrips_into_torch_replica():
     """INVERSE converter for the reference's DEFAULT backbone: a tpuic
     inceptionv3 state exported to torchvision layout loads strict=True into

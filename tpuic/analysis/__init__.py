@@ -20,14 +20,15 @@ Two halves, one discipline:
   the train/serve smoke scripts.
 """
 
-from tpuic.analysis.core import (Finding, Severity, collect_files,
+from tpuic.analysis.core import (PASSES, Finding, Severity,
+                                 analyze_paths, collect_files,
                                  lint_paths, lint_source)
 from tpuic.analysis.rules import RULES, Rule
 from tpuic.analysis.baseline import (fingerprint, load_baseline,
                                      new_findings, write_baseline)
 
 __all__ = [
-    "Finding", "Severity", "Rule", "RULES",
-    "collect_files", "lint_paths", "lint_source",
+    "Finding", "Severity", "Rule", "RULES", "PASSES",
+    "analyze_paths", "collect_files", "lint_paths", "lint_source",
     "fingerprint", "load_baseline", "new_findings", "write_baseline",
 ]

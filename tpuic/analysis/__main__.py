@@ -1,10 +1,17 @@
-"""``python -m tpuic.analysis [paths...]`` — the JAX/TPU footgun linter.
+"""``python -m tpuic.analysis [paths...]`` — the multi-pass analyzer.
+
+Four passes (``--passes``, default all): ``lint`` (the per-file JAX/TPU
+footgun rules, TPU1xx-5xx), ``conc`` (project-wide lock-order graph,
+signal-path safety, thread-closure races, CONC1xx), ``spmd``
+(rank-divergent / order-divergent collectives, SPMD1xx), and ``ctr``
+(event-kind, prom-row, and exit-code cross-artifact contracts, CTR1xx).
 
 Exit codes: 0 = clean against the baseline, 1 = new findings (or, with
 ``--strict``, stale baseline entries), 2 = usage error.
 
     python -m tpuic.analysis tpuic/                 # gate vs baseline
     python -m tpuic.analysis tpuic/ --no-baseline   # every finding
+    python -m tpuic.analysis tpuic/ --passes conc,spmd
     python -m tpuic.analysis tpuic/ --write-baseline  # accept current
     python -m tpuic.analysis --list-rules           # the catalog
 """
@@ -19,7 +26,7 @@ from typing import List, Optional
 
 from tpuic.analysis.baseline import (load_baseline, new_findings,
                                      write_baseline)
-from tpuic.analysis.core import Finding, lint_paths
+from tpuic.analysis.core import PASSES, Finding, analyze_paths
 from tpuic.analysis.rules import RULES
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -30,8 +37,10 @@ DEFAULT_BASELINE = os.path.join(_REPO, "analysis_baseline.json")
 def _print_findings(findings: List[Finding], as_json: bool) -> None:
     if as_json:
         print(json.dumps([{
-            "rule": f.rule, "severity": str(f.severity), "path": f.path,
+            "rule": f.rule, "family": f.family,
+            "severity": str(f.severity), "path": f.path,
             "line": f.line, "message": f.message, "anchor": f.anchor,
+            **({"fkey": f.fkey} if f.fkey else {}),
         } for f in findings], indent=2))
     else:
         for f in findings:
@@ -50,6 +59,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="report every finding; exit 1 if any")
     p.add_argument("--write-baseline", action="store_true",
                    help="accept the current findings as the baseline")
+    p.add_argument("--passes", default=",".join(PASSES),
+                   help="comma-separated passes to run "
+                        f"(default: {','.join(PASSES)})")
     p.add_argument("--select", default=None,
                    help="comma-separated rule IDs to run (default: all)")
     p.add_argument("--exclude", default="",
@@ -75,8 +87,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"unknown rule(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
+    passes = [s.strip() for s in args.passes.split(",") if s.strip()]
+    bad_passes = [s for s in passes if s not in PASSES]
+    if bad_passes:
+        print(f"unknown pass(es): {', '.join(bad_passes)} "
+              f"(valid: {', '.join(PASSES)})", file=sys.stderr)
+        return 2
     exclude = [e.strip() for e in args.exclude.split(",") if e.strip()]
-    findings, files = lint_paths(paths, exclude=exclude, select=select)
+    findings, files = analyze_paths(paths, exclude=exclude,
+                                    select=select, passes=passes)
 
     if args.write_baseline:
         write_baseline(args.baseline, findings)

@@ -50,7 +50,14 @@ def _norm_path(path: str) -> str:
 
 
 def fingerprint(f: Finding) -> str:
-    key = f"{f.rule}|{_norm_path(f.path)}|{f.anchor}"
+    """Project-level findings (``f.fkey`` set — e.g. a lock cycle that
+    spans files) key on their structural identity, not a line: the
+    cycle's sorted edge set survives any edit that doesn't change the
+    lock graph itself."""
+    if f.fkey:
+        key = f"{f.rule}|{f.fkey}"
+    else:
+        key = f"{f.rule}|{_norm_path(f.path)}|{f.anchor}"
     return hashlib.sha1(key.encode()).hexdigest()[:16]
 
 
@@ -75,7 +82,7 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
     entries = []
     for fp, group in grouped.items():
         f = group[0]
-        entries.append({
+        entry = {
             "fingerprint": fp,
             "rule": f.rule,
             "path": _norm_path(f.path),
@@ -83,7 +90,10 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
             "anchor": f.anchor,
             "message": f.message,
             "count": len(group),
-        })
+        }
+        if f.fkey:
+            entry["fkey"] = f.fkey  # the structural key that was hashed
+        entries.append(entry)
     entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": 1, "findings": entries}, fh, indent=2)

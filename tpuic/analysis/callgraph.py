@@ -1,0 +1,261 @@
+"""Project-wide parse + call graph: the shared substrate of the
+multi-pass analyzers (conc/spmd/ctr — docs/analysis.md, "Pass
+architecture").
+
+One :class:`Project` parses every collected file exactly once and
+indexes every function (including nested defs and methods, by
+qualname).  Call resolution is deliberately conservative — an edge the
+resolver is not sure about is an edge that does not exist:
+
+- a bare name resolves through the lexical chain of enclosing defs,
+  then module top-level functions, then project-module imports;
+- ``self.method(...)`` resolves within the enclosing class only;
+- ``obj.method(...)`` resolves only when ``method`` is defined exactly
+  once in the whole project and is not on the common-name stoplist
+  (``get``/``close``/``run``/... would wire the graph into soup).
+
+Unresolved calls simply contribute no edges; the downstream rules are
+precision-biased by construction (a cried-wolf deadlock report gets the
+whole pass suppressed and protects nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tpuic.analysis.core import suppressions
+
+# Method/function names too common to resolve by project-wide
+# uniqueness: an attribute call on these creates no call edge.
+COMMON_NAMES = frozenset({
+    "get", "set", "put", "add", "pop", "open", "close", "run", "start",
+    "stop", "join", "wait", "send", "recv", "read", "write", "flush",
+    "items", "keys", "values", "append", "extend", "update", "copy",
+    "clear", "submit", "result", "state", "snapshot", "reset", "render",
+    "main", "info", "warning", "error", "debug", "exception", "publish",
+    "subscribe", "install", "load", "save", "report", "name", "next",
+    "format", "encode", "decode", "strip", "split", "setdefault",
+})
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _module_dotted(path: str) -> str:
+    """'tpuic.telemetry.events' for a file under the repo root; for
+    files elsewhere (test fixture trees) the path is made relative to
+    its own deepest package-looking ancestor, falling back to the bare
+    stem — only cross-file *identity* matters, not importability."""
+    p = os.path.normpath(os.path.abspath(path))
+    try:
+        rel = os.path.relpath(p, _ROOT)
+    except ValueError:
+        rel = ".."
+    if rel.startswith(".."):
+        # Fixture tree: synthesize from the trailing path components so
+        # 'pkg/sub/mod.py' in a tmp dir still reads as 'pkg.sub.mod'.
+        parts = p.replace("\\", "/").split("/")
+        tail = parts[-3:] if len(parts) >= 3 else parts
+        rel = "/".join(tail)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    return rel.replace("\\", "/").replace("/", ".")
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One def (top-level, method, or nested) in the project."""
+    qualname: str                      # 'Class.method' / 'f.<locals>.g'
+    name: str
+    module: "ModuleInfo"
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]                 # nearest enclosing class, if any
+    parent: Optional["FuncInfo"]       # lexically enclosing def, if any
+    local_defs: Dict[str, "FuncInfo"] = dataclasses.field(
+        default_factory=dict)
+    calls: List[ast.Call] = dataclasses.field(default_factory=list)
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+
+    def allowlisted(self, rule: str) -> bool:
+        """Whether a '# tpuic-ok: RULE why' on this def line (or any
+        enclosing def's) allowlists ``rule`` for the whole body — the
+        same mechanism the lint pass's drain-site allowlist uses."""
+        f: Optional[FuncInfo] = self
+        while f is not None:
+            ids = f.module.supp.get(f.node.lineno, "absent")
+            if ids != "absent" and (ids is None or rule in ids):
+                return True
+            f = f.parent
+        return False
+
+
+class ModuleInfo:
+    """One parsed file: tree, suppression map, per-module indexes."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path.replace("\\", "/")
+        self.dotted = _module_dotted(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.supp = suppressions(source)
+        self.tree: Optional[ast.Module] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            pass  # the lint pass reports TPU000; project passes skip it
+        self.functions: Dict[str, FuncInfo] = {}   # by qualname
+        self.toplevel: Dict[str, FuncInfo] = {}    # module-level defs
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        if self.tree is not None:
+            self._index()
+
+    def _index(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name, None)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.imports[a.asname or a.name] = (node.module,
+                                                            a.name)
+        self._walk(self.tree.body, cls=None, parent=None, prefix="")
+
+    def _walk(self, body: Sequence[ast.stmt], cls: Optional[str],
+              parent: Optional[FuncInfo], prefix: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + stmt.name
+                fi = FuncInfo(qual, stmt.name, self, stmt, cls, parent)
+                self.functions[qual] = fi
+                if parent is not None:
+                    parent.local_defs[stmt.name] = fi
+                elif cls is not None:
+                    self.classes.setdefault(cls, {})[stmt.name] = fi
+                else:
+                    self.toplevel[stmt.name] = fi
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call):
+                        fi.calls.append(n)
+                self._walk(stmt.body, cls, fi,
+                           qual + ".<locals>.")
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk(stmt.body, stmt.name, parent,
+                           prefix + stmt.name + ".")
+            else:
+                # defs nested in plain statements (if TYPE_CHECKING:...)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        self._walk(sub, cls, parent, prefix)
+                for h in getattr(stmt, "handlers", []) or []:
+                    self._walk(h.body, cls, parent, prefix)
+
+
+class Project:
+    """Every module parsed once + global function index + resolution."""
+
+    def __init__(self, files: Sequence[str],
+                 sources: Optional[Dict[str, str]] = None) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        for f in files:
+            if sources is not None and f in sources:
+                src = sources[f]
+            else:
+                with open(f, encoding="utf-8") as fh:
+                    src = fh.read()
+            m = ModuleInfo(f, src)
+            self.modules[m.path] = m
+            self.by_dotted[m.dotted] = m
+        self.name_index: Dict[str, List[FuncInfo]] = {}
+        for m in self.modules.values():
+            for fi in m.functions.values():
+                self.name_index.setdefault(fi.name, []).append(fi)
+
+    # -- lookup --------------------------------------------------------
+    def module_ending(self, suffix: str) -> Optional[ModuleInfo]:
+        """The unique module whose path ends with ``suffix`` (e.g.
+        'tpuic/telemetry/events.py'), else None."""
+        hits = [m for m in self.modules.values()
+                if m.path.endswith(suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+    def funcs(self) -> Iterable[FuncInfo]:
+        for m in self.modules.values():
+            yield from m.functions.values()
+
+    # -- call resolution ----------------------------------------------
+    def resolve_name(self, caller: Optional[FuncInfo], mod: ModuleInfo,
+                     name: str) -> Optional[FuncInfo]:
+        f = caller
+        while f is not None:
+            if name in f.local_defs:
+                return f.local_defs[name]
+            f = f.parent
+        if name in mod.toplevel:
+            return mod.toplevel[name]
+        imp = mod.imports.get(name)
+        if imp is not None:
+            src_mod, src_name = imp
+            target = self.by_dotted.get(src_mod)
+            if target is not None and src_name is not None:
+                return target.toplevel.get(src_name)
+        return None
+
+    def resolve_call(self, caller: FuncInfo,
+                     call: ast.Call) -> List[FuncInfo]:
+        d = dotted(call.func)
+        if d is None:
+            return []
+        parts = d.split(".")
+        if len(parts) == 1:
+            hit = self.resolve_name(caller, caller.module, parts[0])
+            return [hit] if hit is not None else []
+        if parts[0] == "self" and len(parts) == 2 and caller.cls:
+            meth = caller.module.classes.get(caller.cls, {}).get(parts[1])
+            if meth is not None:
+                return [meth]
+        tail = parts[-1]
+        if tail in COMMON_NAMES:
+            return []
+        cands = self.name_index.get(tail, [])
+        return list(cands) if len(cands) == 1 else []
+
+    def reachable(self, roots: Iterable[FuncInfo]) -> List[FuncInfo]:
+        """BFS closure over resolved call edges, roots included, in
+        discovery order (stable for deterministic findings)."""
+        seen: Set[int] = set()
+        order: List[FuncInfo] = []
+        queue = list(roots)
+        while queue:
+            fi = queue.pop(0)
+            if id(fi) in seen:
+                continue
+            seen.add(id(fi))
+            order.append(fi)
+            for call in fi.calls:
+                for callee in self.resolve_call(fi, call):
+                    if id(callee) not in seen:
+                        queue.append(callee)
+        return order

@@ -1,0 +1,534 @@
+"""CONC — project-wide concurrency analysis (docs/analysis.md).
+
+Three rules over the :class:`~tpuic.analysis.callgraph.Project` call
+graph:
+
+- **CONC101 lock-order-cycle**: every ``with <lock>:`` block contributes
+  ordered edges L→M for each lock M acquired inside it (directly,
+  lexically nested, or transitively through resolved calls).  A cycle in
+  that graph is a potential deadlock the moment two threads run the two
+  paths concurrently.  The finding is project-level (a cycle spans
+  files) and fingerprints on the sorted edge set, not a line.
+- **CONC102 signal-unsafe-call**: functions reachable from any
+  ``signal.signal``/``faulthandler.register`` registration form the
+  signal path.  Inside it, acquiring a project lock, publishing to the
+  event bus, or mutating a *shared* (self-attribute) file handle is
+  flagged — the handler may have interrupted the very frame that holds
+  the lock / owns the handle (the PR-8 FlightRecorder deadlock,
+  codified; its lock-free+bus-free ``dump()`` is the good fixture).
+  Opening and writing a *local* file is fine — that is exactly what a
+  dump-from-signal must do.
+- **CONC103 unlocked-shared-closure**: a ``threading.Thread(target=f)``
+  where the nested target ``f`` mutates a closure variable the spawning
+  scope also mutates after the spawn, with neither side under a lock.
+
+Lock identity is ``module::Class.attr`` for ``self._lock`` attributes,
+``module::name`` for module globals, and ``module::func().name`` for
+function locals.  ``threading.Condition(self._lock)`` aliases the
+wrapped lock (waiting on the condition IS holding that lock).  A
+``self.X`` / ``obj.X`` acquisition whose attribute name is defined as a
+lock exactly once project-wide resolves to it; ambiguous receivers
+contribute acquisition *sites* (CONC102) but no order edges.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tpuic.analysis.callgraph import FuncInfo, Project, dotted
+from tpuic.analysis.core import Finding, Severity
+
+_LOCK_CTORS = {"threading.Lock": False, "threading.RLock": True,
+               "Lock": False, "RLock": True}
+_COND_CTORS = {"threading.Condition", "Condition"}
+_MUTATORS = {"append", "extend", "add", "update", "insert", "pop",
+             "remove", "discard", "clear", "setdefault"}
+_FH_MUTATORS = {"write", "writelines", "flush", "truncate"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    key: str          # 'module::Class.attr' — the graph node identity
+    attr: str         # bare attribute/variable name
+    path: str
+    line: int
+    reentrant: bool
+
+
+class _LockIndex:
+    """Every lock/condition construction in the project + resolution of
+    acquisition expressions back to lock identities."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.defs: Dict[str, LockDef] = {}
+        self.by_attr: Dict[str, List[LockDef]] = {}
+        for mod in project.modules.values():
+            if mod.tree is not None:
+                self._scan_module(mod)
+
+    def _add(self, key: str, attr: str, path: str, line: int,
+             reentrant: bool) -> LockDef:
+        d = self.defs.get(key)
+        if d is None:
+            d = LockDef(key, attr, path, line, reentrant)
+            self.defs[key] = d
+            self.by_attr.setdefault(attr, []).append(d)
+        return d
+
+    def _scan_module(self, mod) -> None:
+        # Walk with (class, function) context so `self._lock = Lock()`
+        # lands on the right class even inside nested defs.
+        def walk(body, cls: Optional[str], fn: Optional[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    walk(stmt.body, stmt.name, fn)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk(stmt.body, cls, fn or stmt.name)
+                    continue
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Call):
+                    self._scan_assign(mod, stmt, cls, fn)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        walk(sub, cls, fn)
+                for h in getattr(stmt, "handlers", []) or []:
+                    walk(h.body, cls, fn)
+        walk(mod.tree.body, None, None)
+
+    def _target_key(self, mod, target: ast.AST, cls: Optional[str],
+                    fn: Optional[str]) -> Optional[Tuple[str, str]]:
+        """(graph key, bare attr name) for a lock-assignment target."""
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and cls is not None:
+            return f"{mod.dotted}::{cls}.{target.attr}", target.attr
+        if isinstance(target, ast.Name):
+            if fn is None:
+                return f"{mod.dotted}::{target.id}", target.id
+            return f"{mod.dotted}::{fn}().{target.id}", target.id
+        return None
+
+    def _scan_assign(self, mod, stmt: ast.Assign, cls: Optional[str],
+                     fn: Optional[str]) -> None:
+        d = dotted(stmt.value.func)
+        if d in _LOCK_CTORS:
+            for t in stmt.targets:
+                tk = self._target_key(mod, t, cls, fn)
+                if tk is not None:
+                    self._add(tk[0], tk[1], mod.path, stmt.lineno,
+                              _LOCK_CTORS[d])
+        elif d in _COND_CTORS:
+            # Condition(self._lock) aliases the wrapped lock; a bare
+            # Condition() owns a private (R)Lock of its own.
+            args = stmt.value.args
+            alias: Optional[LockDef] = None
+            if args:
+                src = args[0]
+                if isinstance(src, ast.Attribute) \
+                        and isinstance(src.value, ast.Name) \
+                        and src.value.id == "self" and cls is not None:
+                    alias = self.defs.get(
+                        f"{mod.dotted}::{cls}.{src.attr}")
+                elif isinstance(src, ast.Name):
+                    alias = self.defs.get(f"{mod.dotted}::{src.id}")
+            for t in stmt.targets:
+                tk = self._target_key(mod, t, cls, fn)
+                if tk is None:
+                    continue
+                if alias is not None:
+                    self.defs[tk[0]] = alias  # same node, second name
+                    self.by_attr.setdefault(tk[1], []).append(alias)
+                else:
+                    self._add(tk[0], tk[1], mod.path, stmt.lineno, True)
+
+    def resolve(self, fi: FuncInfo, expr: ast.AST) -> Optional[LockDef]:
+        """Lock identity for an acquisition expression, else None."""
+        mod = fi.module
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and fi.cls is not None:
+                d = self.defs.get(f"{mod.dotted}::{fi.cls}.{expr.attr}")
+                if d is not None:
+                    return d
+            cands = self.by_attr.get(expr.attr, [])
+            uniq = {c.key: c for c in cands}
+            if len(uniq) == 1:
+                return next(iter(uniq.values()))
+            return None
+        if isinstance(expr, ast.Name):
+            # Enclosing-function locals first, then module globals.
+            f: Optional[FuncInfo] = fi
+            while f is not None:
+                d = self.defs.get(
+                    f"{mod.dotted}::{f.name}().{expr.id}")
+                if d is not None:
+                    return d
+                f = f.parent
+            return self.defs.get(f"{mod.dotted}::{expr.id}")
+        return None
+
+
+def _acquisitions(index: _LockIndex, fi: FuncInfo
+                  ) -> List[Tuple[LockDef, int]]:
+    """Every lock acquisition in ``fi``'s own body (nested defs have
+    their own FuncInfo): with-blocks and explicit .acquire() calls."""
+    out: List[Tuple[LockDef, int]] = []
+    for node in _own_nodes(fi):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                d = index.resolve(fi, item.context_expr)
+                if d is not None:
+                    out.append((d, node.lineno))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            d = index.resolve(fi, node.func.value)
+            if d is not None:
+                out.append((d, node.lineno))
+    return out
+
+
+def _own_nodes(fi: FuncInfo) -> List[ast.AST]:
+    """All nodes in fi's body excluding nested def/class bodies."""
+    out: List[ast.AST] = []
+
+    def rec(n: ast.AST) -> None:
+        out.append(n)
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            rec(c)
+    for s in fi.node.body:
+        rec(s)
+    return out
+
+
+def _transitive_acquires(project: Project, index: _LockIndex
+                         ) -> Dict[int, Set[str]]:
+    """id(FuncInfo) -> lock keys acquired by the function or anything it
+    (transitively) calls.  Iterated to a fixpoint; graphs are small."""
+    funcs = list(project.funcs())
+    direct: Dict[int, Set[str]] = {
+        id(f): {d.key for d, _ in _acquisitions(index, f)}
+        for f in funcs}
+    callees: Dict[int, List[int]] = {}
+    for f in funcs:
+        outs: List[int] = []
+        for call in f.calls:
+            outs.extend(id(c) for c in project.resolve_call(f, call))
+        callees[id(f)] = outs
+    acc = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for f in funcs:
+            s = acc[id(f)]
+            before = len(s)
+            for c in callees[id(f)]:
+                s |= acc.get(c, set())
+            if len(s) != before:
+                changed = True
+    return acc
+
+
+# -- CONC101 ------------------------------------------------------------
+def _lock_edges(project: Project, index: _LockIndex,
+                trans: Dict[int, Set[str]]
+                ) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+    """(L, M) -> one representative (path, line, holder-qualname) where
+    M is acquired (directly or via a resolved call) inside a with-block
+    holding L."""
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for fi in project.funcs():
+        for node in _own_nodes(fi):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            outer = [index.resolve(fi, it.context_expr)
+                     for it in node.items]
+            outer = [d for d in outer if d is not None]
+            if not outer:
+                continue
+            inner: List[Tuple[str, int]] = []
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for it in sub.items:
+                        d = index.resolve(fi, it.context_expr)
+                        if d is not None:
+                            inner.append((d.key, sub.lineno))
+                elif isinstance(sub, ast.Call):
+                    if isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "acquire":
+                        d = index.resolve(fi, sub.func.value)
+                        if d is not None:
+                            inner.append((d.key, sub.lineno))
+                    for callee in project.resolve_call(fi, sub):
+                        for key in trans.get(id(callee), ()):
+                            inner.append((key, sub.lineno))
+            for L in outer:
+                for key, line in inner:
+                    if key == L.key:
+                        continue
+                    edges.setdefault((L.key, key),
+                                     (fi.module.path, line, fi.qualname))
+    return edges
+
+
+def _cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    """Strongly connected components with >= 2 nodes (lock cycles)."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:  # iterative Tarjan
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                idx[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on.add(node)
+            recursed = False
+            for i in range(pi, len(graph[node])):
+                w = graph[node][i]
+                if w not in idx:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    recursed = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], idx[w])
+            if recursed:
+                continue
+            if low[node] == idx[node]:
+                comp: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    for v in sorted(graph):
+        if v not in idx:
+            strong(v)
+    return out
+
+
+# -- CONC102 ------------------------------------------------------------
+def _signal_handlers(project: Project) -> List[Tuple[FuncInfo, str]]:
+    """(handler FuncInfo, registration 'path:line') pairs for every
+    ``signal.signal(sig, handler)`` with a resolvable handler.
+    ``faulthandler.register`` takes no Python callable (C level), so it
+    anchors the path-set but contributes no reachable functions."""
+    out: List[Tuple[FuncInfo, str]] = []
+    for fi in project.funcs():
+        for call in fi.calls:
+            if dotted(call.func) != "signal.signal" \
+                    or len(call.args) < 2:
+                continue
+            h = call.args[1]
+            target: Optional[FuncInfo] = None
+            if isinstance(h, ast.Name):
+                target = project.resolve_name(fi, fi.module, h.id)
+            elif isinstance(h, ast.Attribute) \
+                    and isinstance(h.value, ast.Name) \
+                    and h.value.id == "self" and fi.cls is not None:
+                target = fi.module.classes.get(fi.cls, {}).get(h.attr)
+            if target is not None:
+                out.append((target,
+                            f"{fi.module.path}:{call.lineno}"))
+    return out
+
+
+def _conc102_violations(index: _LockIndex, fi: FuncInfo
+                        ) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for d, line in _acquisitions(index, fi):
+        out.append((line, f"acquires lock '{d.key}'"))
+    for node in _own_nodes(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is not None and d.split(".")[-1].endswith("publish"):
+            out.append((node.lineno,
+                        f"publishes to the event bus via {d}()"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _FH_MUTATORS \
+                and isinstance(node.func.value, ast.Attribute) \
+                and isinstance(node.func.value.value, ast.Name) \
+                and node.func.value.value.id == "self":
+            out.append((node.lineno,
+                        f"mutates shared file handle "
+                        f"'self.{node.func.value.attr}."
+                        f"{node.func.attr}()'"))
+    return out
+
+
+# -- CONC103 ------------------------------------------------------------
+def _thread_closure_races(index: _LockIndex, fi: FuncInfo
+                          ) -> List[Tuple[int, str]]:
+    """Thread(target=<nested def>) whose target and spawning scope both
+    mutate one closure variable after the spawn, with no lock on either
+    side."""
+    out: List[Tuple[int, str]] = []
+    own = _own_nodes(fi)
+    lock_lines: List[Tuple[int, int]] = []  # guarded line spans
+    for n in own:
+        if isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                index.resolve(fi, it.context_expr) is not None
+                for it in n.items):
+            lock_lines.append((n.lineno,
+                               getattr(n, "end_lineno", n.lineno)
+                               or n.lineno))
+
+    def guarded(line: int, spans=None) -> bool:
+        for lo, hi in (spans if spans is not None else lock_lines):
+            if lo <= line <= hi:
+                return True
+        return False
+
+    for node in own:
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted(node.func) not in ("threading.Thread", "Thread"):
+            continue
+        target: Optional[FuncInfo] = None
+        for kw in node.keywords:
+            if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                target = fi.local_defs.get(kw.value.id)
+        if target is None:
+            continue
+        t_params = set(target.params())
+        t_spans = []
+        for n in _own_nodes(target):
+            if isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                    index.resolve(target, it.context_expr) is not None
+                    for it in n.items):
+                t_spans.append((n.lineno,
+                                getattr(n, "end_lineno", n.lineno)
+                                or n.lineno))
+        t_mutated: Set[str] = set()
+        for n in _own_nodes(target):
+            name: Optional[str] = None
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _MUTATORS \
+                    and isinstance(n.func.value, ast.Name):
+                name = n.func.value.id
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                tgt = n.targets[0] if isinstance(n, ast.Assign) \
+                    else n.target
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name):
+                    name = tgt.value.id
+            if name is None or name in t_params or guarded(
+                    n.lineno, t_spans):
+                continue
+            # Closure var only if the SPAWNING scope binds it.
+            if any(isinstance(m, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in m.targets) for m in own):
+                t_mutated.add(name)
+        if not t_mutated:
+            continue
+        for n in own:
+            name = None
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _MUTATORS \
+                    and isinstance(n.func.value, ast.Name):
+                name = n.func.value.id
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                tgt = n.targets[0] if isinstance(n, ast.Assign) \
+                    else n.target
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name):
+                    name = tgt.value.id
+            if name in t_mutated and n.lineno > node.lineno \
+                    and not guarded(n.lineno):
+                out.append((node.lineno,
+                            f"thread target '{target.name}' and the "
+                            f"spawning scope both mutate '{name}' "
+                            f"with no common lock"))
+                break
+    return out
+
+
+# -- the pass -----------------------------------------------------------
+def lock_order_edges(project: Project) -> Set[Tuple[str, str]]:
+    """The static lock-order graph as (holder-key, acquired-key) pairs —
+    the cross-check input for ``runtime.LockOrderWatch.check()``."""
+    index = _LockIndex(project)
+    trans = _transitive_acquires(project, index)
+    return set(_lock_edges(project, index, trans).keys())
+
+
+def run_conc(project: Project) -> List[Finding]:
+    index = _LockIndex(project)
+    trans = _transitive_acquires(project, index)
+    findings: List[Finding] = []
+
+    edges = _lock_edges(project, index, trans)
+    for cycle in _cycles(edges.keys()):
+        in_cycle = set(cycle)
+        cyc_edges = sorted((a, b) for a, b in edges
+                           if a in in_cycle and b in in_cycle)
+        path, line, qual = edges[cyc_edges[0]]
+        desc = ", ".join(f"{a} -> {b}" for a, b in cyc_edges)
+        findings.append(Finding(
+            "CONC101", Severity.ERROR, path, line,
+            f"lock-order cycle ({desc}) — two threads taking these "
+            f"locks in opposite orders deadlock; first edge closes in "
+            f"{qual}()",
+            fkey="conc101:" + ";".join(f"{a}->{b}"
+                                       for a, b in cyc_edges)))
+
+    seen_sites: Set[Tuple[str, int, str]] = set()
+    for handler, reg in _signal_handlers(project):
+        for fi in project.reachable([handler]):
+            if fi.allowlisted("CONC102"):
+                continue
+            for line, what in _conc102_violations(index, fi):
+                site = (fi.module.path, line, what)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                findings.append(Finding(
+                    "CONC102", Severity.ERROR, fi.module.path, line,
+                    f"{what} inside the signal path "
+                    f"({handler.qualname}() registered at {reg}, "
+                    f"reached via {fi.qualname}()) — the handler may "
+                    f"have interrupted the frame that holds it; the "
+                    f"signal path must stay lock-free and bus-free"))
+
+    for fi in project.funcs():
+        if fi.allowlisted("CONC103"):
+            continue
+        for line, msg in _thread_closure_races(index, fi):
+            findings.append(Finding(
+                "CONC103", Severity.WARNING, fi.module.path, line,
+                msg + " — guard both sides or hand results over a "
+                      "queue"))
+    return findings

@@ -1,0 +1,378 @@
+"""CTR — cross-artifact contract checks (docs/analysis.md).
+
+Code, registry, and docs drift apart silently: a new event kind that
+never gets a schema row, a prom row a dashboard can't look up, an exit
+code the supervisor honors but the runbook doesn't mention.  These
+rules re-derive each contract from the AST on every run:
+
+- **CTR101 event-kind-contract**: every ``*.publish("kind", ...)`` call
+  site's kind is registered in ``EVENT_KINDS``
+  (tpuic/telemetry/events.py), and every registered kind has a schema
+  row (``| `kind` | ... |``) in docs/observability.md.  Wrapper
+  resolution: a call whose callee resolves to a project def forwards
+  its first argument as a kind only when that parameter is literally
+  named ``kind`` (``Router._publish(self, kind, ...)``); a wrapper with
+  its own vocabulary (``RolloutDriver._publish(self, action, ...)``)
+  is not a kind site — the fixed kind its body publishes is.
+- **CTR102 prom-row-contract**: every metric row name emitted by
+  tpuic/telemetry/prom.py appears in docs/observability.md.  Row names
+  are extracted structurally — a row is a 5-tuple whose TYPE element is
+  ``"gauge"``/``"counter"``; f-string and loop-variable names are
+  expanded from the literal tuples they iterate (a name the extractor
+  cannot resolve statically is itself a finding: emitted names must
+  stay statically enumerable).
+- **CTR103 exit-code-contract**: the supervisor exit-code constants
+  (``EXIT_* = <int>`` in runtime/supervisor.py) are pairwise distinct,
+  never shadowed with a different value in runtime/gang.py, never used
+  as raw integer literals in ``sys.exit()``/``SystemExit`` in either
+  module, and each nonzero code's number and constant name both appear
+  in docs/robustness.md (the supervision contract table / prose).
+
+The pass anchors on the canonical artifacts by path suffix; a scan tree
+without them (a test fixture dir) simply runs the subset it can see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpuic.analysis.callgraph import FuncInfo, ModuleInfo, Project, dotted
+from tpuic.analysis.core import Finding, Severity
+
+_EVENTS_SUFFIX = "tpuic/telemetry/events.py"
+_PROM_SUFFIX = "tpuic/telemetry/prom.py"
+_SUP_SUFFIX = "tpuic/runtime/supervisor.py"
+_GANG_SUFFIX = "tpuic/runtime/gang.py"
+
+
+def _docs_dir(anchor_path: str) -> str:
+    """<repo>/docs for an anchor like <repo>/tpuic/telemetry/events.py."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(anchor_path))))
+    return os.path.join(root, "docs")
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+# -- CTR101 -------------------------------------------------------------
+def _event_kinds(mod: ModuleInfo) -> Optional[List[Tuple[str, int]]]:
+    """(kind, lineno) for every entry of the EVENT_KINDS tuple."""
+    if mod.tree is None:
+        return None
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return [(e.value, e.lineno) for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return None
+
+
+def _publish_kind_sites(project: Project
+                        ) -> List[Tuple[str, int, str, FuncInfo]]:
+    """(kind, lineno, path, publisher) for every statically-known
+    publish kind in the project."""
+    out: List[Tuple[str, int, str, FuncInfo]] = []
+    for fi in project.funcs():
+        for call in fi.calls:
+            d = dotted(call.func)
+            if d is None or not d.split(".")[-1].endswith("publish"):
+                continue
+            # Resolve through wrappers: a project def forwards a kind
+            # only when its first non-self parameter is named 'kind'.
+            resolved = project.resolve_call(fi, call)
+            if not resolved and isinstance(call.func, ast.Attribute) \
+                    and isinstance(call.func.value, ast.Name) \
+                    and call.func.value.id == "self" and fi.cls:
+                meth = fi.module.classes.get(fi.cls, {}).get(
+                    call.func.attr)
+                resolved = [meth] if meth is not None else []
+            if resolved:
+                params = resolved[0].params()
+                if params and params[0] == "self":
+                    params = params[1:]
+                if not params or params[0] != "kind":
+                    continue  # wrapper with its own vocabulary
+            kind_expr: Optional[ast.AST] = None
+            if call.args:
+                kind_expr = call.args[0]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "kind":
+                        kind_expr = kw.value
+            if isinstance(kind_expr, ast.Constant) \
+                    and isinstance(kind_expr.value, str):
+                out.append((kind_expr.value, call.lineno,
+                            fi.module.path, fi))
+    return out
+
+
+def _ctr101(project: Project) -> List[Finding]:
+    events = project.module_ending(_EVENTS_SUFFIX)
+    if events is None:
+        return []
+    kinds = _event_kinds(events)
+    if kinds is None:
+        return [Finding("CTR101", Severity.ERROR, events.path, 1,
+                        "EVENT_KINDS tuple not found (or not a literal "
+                        "tuple of strings) — the event-kind contract "
+                        "cannot be checked")]
+    registered = {k for k, _ in kinds}
+    findings: List[Finding] = []
+    for kind, line, path, fi in _publish_kind_sites(project):
+        if kind not in registered and not fi.allowlisted("CTR101"):
+            findings.append(Finding(
+                "CTR101", Severity.ERROR, path, line,
+                f"published event kind '{kind}' is not registered in "
+                f"EVENT_KINDS ({events.path}) — register it and add "
+                f"its schema row to docs/observability.md"))
+    doc = _read(os.path.join(_docs_dir(events.path), "observability.md"))
+    if doc is not None:
+        for kind, line in kinds:
+            if not re.search(rf"^\|\s*`{re.escape(kind)}`\s*\|", doc,
+                             re.MULTILINE):
+                findings.append(Finding(
+                    "CTR101", Severity.ERROR, events.path, line,
+                    f"event kind '{kind}' has no schema row in "
+                    f"docs/observability.md (expected a table row "
+                    f"'| `{kind}` | ... |')",
+                    fkey=f"ctr101:doc:{kind}"))
+    return findings
+
+
+# -- CTR102 -------------------------------------------------------------
+def _loop_expansions(fn_node: ast.AST) -> Dict[int, List[str]]:
+    """id(loop-variable Name binding) -> the literal strings it ranges
+    over: for a ``for field, ... in (("a", ...), ("b", ...)):`` loop,
+    the target element's position indexes each literal tuple."""
+    out: Dict[int, List[str]] = {}
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.For) \
+                or not isinstance(node.iter, (ast.Tuple, ast.List)):
+            continue
+        targets: List[ast.Name] = []
+        if isinstance(node.target, ast.Name):
+            targets = [node.target]
+        elif isinstance(node.target, ast.Tuple):
+            targets = [e for e in node.target.elts
+                       if isinstance(e, ast.Name)]
+        positions = {t.id: i for i, t in enumerate(
+            node.target.elts if isinstance(node.target, ast.Tuple)
+            else [node.target]) if isinstance(t, ast.Name)}
+        for name, pos in positions.items():
+            vals: List[str] = []
+            ok = True
+            for elt in node.iter.elts:
+                item = elt
+                if isinstance(elt, (ast.Tuple, ast.List)):
+                    item = (elt.elts[pos] if pos < len(elt.elts)
+                            else None)
+                elif pos != 0:
+                    ok = False
+                    break
+                if isinstance(item, ast.Constant) \
+                        and isinstance(item.value, str):
+                    vals.append(item.value)
+                else:
+                    ok = False
+                    break
+            if ok and vals:
+                out[hash((id(node), name))] = vals
+                out.setdefault(name, vals)  # by-name fallback
+    return out
+
+
+def _row_names(mod: ModuleInfo) -> Tuple[Set[str], List[Tuple[int, str]]]:
+    """(statically-known row names, unresolvable sites) over prom.py.
+
+    A row is any 5-element tuple whose third element is the literal
+    metric type ``"gauge"``/``"counter"`` — the shape every
+    ``rows.append((name, value, type, help, labels))`` site shares."""
+    names: Set[str] = set()
+    bad: List[Tuple[int, str]] = []
+    if mod.tree is None:
+        return names, bad
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        expand = _loop_expansions(fn)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Tuple)
+                    and len(node.elts) == 5
+                    and isinstance(node.elts[2], ast.Constant)
+                    and node.elts[2].value in ("gauge", "counter")):
+                continue
+            head = node.elts[0]
+            if isinstance(head, ast.Constant) \
+                    and isinstance(head.value, str):
+                names.add(head.value)
+            elif isinstance(head, ast.Name) and head.id in expand:
+                names.update(expand[head.id])
+            elif isinstance(head, ast.JoinedStr):
+                parts: List[List[str]] = []
+                ok = True
+                for v in head.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append([str(v.value)])
+                    elif isinstance(v, ast.FormattedValue) \
+                            and isinstance(v.value, ast.Name) \
+                            and v.value.id in expand:
+                        parts.append(expand[v.value.id])
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    combos = [""]
+                    for p in parts:
+                        combos = [c + s for c in combos for s in p]
+                    names.update(combos)
+                else:
+                    bad.append((node.lineno, ast.unparse(head)
+                                if hasattr(ast, "unparse")
+                                else "<f-string>"))
+            else:
+                bad.append((node.lineno,
+                            ast.unparse(head) if hasattr(ast, "unparse")
+                            else "<expr>"))
+    return names, bad
+
+
+def _ctr102(project: Project) -> List[Finding]:
+    prom = project.module_ending(_PROM_SUFFIX)
+    if prom is None:
+        return []
+    names, bad = _row_names(prom)
+    findings: List[Finding] = []
+    for line, expr in bad:
+        findings.append(Finding(
+            "CTR102", Severity.WARNING, prom.path, line,
+            f"metric row name {expr!r} is not statically enumerable — "
+            f"the docs contract can only be checked for literal (or "
+            f"literal-loop-expanded) names"))
+    doc = _read(os.path.join(_docs_dir(prom.path), "observability.md"))
+    if doc is None:
+        return findings
+    for name in sorted(names):
+        if name not in doc:
+            findings.append(Finding(
+                "CTR102", Severity.WARNING, prom.path, 1,
+                f"prom row '{name}' is emitted but never mentioned in "
+                f"docs/observability.md — add it to the metric "
+                f"reference",
+                fkey=f"ctr102:{name}"))
+    return findings
+
+
+# -- CTR103 -------------------------------------------------------------
+def _exit_constants(mod: ModuleInfo) -> Dict[str, Tuple[int, int]]:
+    """name -> (value, lineno) for module-level ``EXIT_* = <int>``."""
+    out: Dict[str, Tuple[int, int]] = {}
+    if mod.tree is None:
+        return out
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.startswith("EXIT_") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _raw_exit_literals(mod: ModuleInfo,
+                       values: Set[int]) -> List[Tuple[int, int]]:
+    """(lineno, value) of sys.exit(<raw int>)/SystemExit(<raw int>)
+    calls using a contract value as a bare literal."""
+    out: List[Tuple[int, int]] = []
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and dotted(node.func) in ("sys.exit", "exit",
+                                          "SystemExit") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, int) \
+                and node.args[0].value in values \
+                and node.args[0].value != 0:
+            out.append((node.lineno, node.args[0].value))
+    return out
+
+
+def _ctr103(project: Project) -> List[Finding]:
+    sup = project.module_ending(_SUP_SUFFIX)
+    if sup is None:
+        return []
+    consts = _exit_constants(sup)
+    findings: List[Finding] = []
+    if not consts:
+        return [Finding("CTR103", Severity.ERROR, sup.path, 1,
+                        "no EXIT_* integer constants found in the "
+                        "supervisor — the exit-code contract cannot "
+                        "be checked")]
+    by_value: Dict[int, List[str]] = {}
+    for name, (val, _line) in consts.items():
+        by_value.setdefault(val, []).append(name)
+    for val, names in sorted(by_value.items()):
+        if len(names) > 1:
+            line = consts[names[0]][1]
+            findings.append(Finding(
+                "CTR103", Severity.ERROR, sup.path, line,
+                f"exit-code constants {', '.join(sorted(names))} share "
+                f"the value {val} — the supervisor cannot classify the "
+                f"child's death"))
+    gang = project.module_ending(_GANG_SUFFIX)
+    if gang is not None:
+        for name, (val, line) in _exit_constants(gang).items():
+            if name in consts and consts[name][0] != val:
+                findings.append(Finding(
+                    "CTR103", Severity.ERROR, gang.path, line,
+                    f"{name} redefined as {val} here but "
+                    f"{consts[name][0]} in the supervisor — one "
+                    f"contract, one definition: import it"))
+    values = {v for v, _ in consts.values()}
+    for mod in (sup, gang):
+        if mod is None:
+            continue
+        for line, val in _raw_exit_literals(mod, values):
+            names = "/".join(sorted(by_value[val]))
+            findings.append(Finding(
+                "CTR103", Severity.ERROR, mod.path, line,
+                f"raw exit literal {val} — use the {names} constant so "
+                f"the contract has one definition"))
+    doc = _read(os.path.join(_docs_dir(sup.path), "robustness.md"))
+    if doc is not None:
+        for name, (val, line) in sorted(consts.items()):
+            if val == 0:
+                continue
+            if not re.search(rf"\b{val}\b", doc):
+                findings.append(Finding(
+                    "CTR103", Severity.ERROR, sup.path, line,
+                    f"exit code {val} ({name}) does not appear in "
+                    f"docs/robustness.md — the supervision contract "
+                    f"table must cover it",
+                    fkey=f"ctr103:value:{val}"))
+            elif name not in doc:
+                findings.append(Finding(
+                    "CTR103", Severity.ERROR, sup.path, line,
+                    f"constant {name} (= {val}) is never named in "
+                    f"docs/robustness.md — name it where the code is "
+                    f"documented so grep finds the contract",
+                    fkey=f"ctr103:name:{name}"))
+    return findings
+
+
+def run_ctr(project: Project) -> List[Finding]:
+    return _ctr101(project) + _ctr102(project) + _ctr103(project)

@@ -40,12 +40,24 @@ class Severity(enum.IntEnum):
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str            # e.g. 'TPU101'
+    rule: str            # e.g. 'TPU101' / 'CONC101'
     severity: Severity
     path: str            # as given to the linter (relative in CI)
     line: int            # 1-based
     message: str
     anchor: str = ""     # stripped source text of the offending line
+    # Project-level findings (a lock cycle spans files) set ``fkey`` to
+    # a stable structural key (e.g. the sorted edge set); the baseline
+    # fingerprints on it instead of path|anchor so unrelated edits
+    # don't churn the entry.
+    fkey: str = ""
+
+    @property
+    def family(self) -> str:
+        """'lint' for TPU rules, else the lowercased rule prefix —
+        matches the ``--passes`` vocabulary."""
+        prefix = self.rule.rstrip("0123456789")
+        return "lint" if prefix == "TPU" else prefix.lower()
 
     def render(self) -> str:
         return (f"{self.path}:{self.line}: {self.rule} "
@@ -53,17 +65,18 @@ class Finding:
 
 
 _SUPPRESS_RE = re.compile(r"#\s*tpuic-ok:\s*(.*)")
-_RULE_ID_RE = re.compile(r"TPU\d+")
+_RULE_ID_RE = re.compile(r"(?:TPU|CONC|SPMD|CTR)\d+")
 
 
 def suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
     """{line: set of suppressed rule IDs, or None for 'all rules'}.
 
     Parsed from real COMMENT tokens, so a ``tpuic-ok`` inside a string
-    literal doesn't silence anything.  Any ``TPU###`` ID anywhere after
-    the colon names a suppressed rule (so rationale text before the ID
-    still suppresses only that rule, never everything); a comment with
-    no ID at all is the deliberate suppress-all form.
+    literal doesn't silence anything.  Any rule ID (``TPU###`` /
+    ``CONC###`` / ``SPMD###`` / ``CTR###``) anywhere after the colon
+    names a suppressed rule (so rationale text before the ID still
+    suppresses only that rule, never everything); a comment with no ID
+    at all is the deliberate suppress-all form.
     """
     out: Dict[int, Optional[Set[str]]] = {}
     try:
@@ -143,10 +156,65 @@ def lint_source(source: str, path: str,
 def lint_paths(paths: Sequence[str], exclude: Sequence[str] = (),
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], List[str]]:
-    """Lint every file under ``paths``; returns (findings, files)."""
+    """Lint every file under ``paths``; returns (findings, files).
+    The per-file pass only — :func:`analyze_paths` runs the project
+    passes too."""
     files = collect_files(paths, exclude)
     findings: List[Finding] = []
     for f in files:
         with open(f, encoding="utf-8") as fh:
             findings.extend(lint_source(fh.read(), f, select=select))
     return findings, files
+
+
+PASSES = ("lint", "conc", "spmd", "ctr")
+
+
+def analyze_paths(paths: Sequence[str], exclude: Sequence[str] = (),
+                  select: Optional[Iterable[str]] = None,
+                  passes: Sequence[str] = PASSES
+                  ) -> Tuple[List[Finding], List[str]]:
+    """The multi-pass driver: the per-file lint pass plus the
+    project-wide passes (conc/spmd/ctr) over one shared parse.
+
+    Every pass rides the same machinery: ``select`` restricts rule IDs,
+    inline ``# tpuic-ok: RULE why`` comments on the anchored line (or
+    the enclosing ``def`` line, for the project rules) suppress, and
+    the returned findings carry anchors for baseline fingerprinting.
+    Returns (findings, files).
+    """
+    files = collect_files(paths, exclude)
+    sources: Dict[str, str] = {}
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            sources[f] = fh.read()
+    findings: List[Finding] = []
+    if "lint" in passes:
+        for f in files:
+            findings.extend(lint_source(sources[f], f, select=select))
+    if any(p in passes for p in ("conc", "spmd", "ctr")):
+        from tpuic.analysis.callgraph import Project
+        project = Project(files, sources)
+        raw: List[Finding] = []
+        if "conc" in passes:
+            from tpuic.analysis.conc import run_conc
+            raw.extend(run_conc(project))
+        if "spmd" in passes:
+            from tpuic.analysis.spmd import run_spmd
+            raw.extend(run_spmd(project))
+        if "ctr" in passes:
+            from tpuic.analysis.contracts import run_ctr
+            raw.extend(run_ctr(project))
+        chosen = set(select) if select is not None else None
+        for f in raw:
+            if chosen is not None and f.rule not in chosen:
+                continue
+            mod = project.modules.get(f.path.replace("\\", "/"))
+            if mod is not None:
+                if is_suppressed(f, mod.supp):
+                    continue
+                text = (mod.lines[f.line - 1].strip()
+                        if 0 < f.line <= len(mod.lines) else "")
+                f = dataclasses.replace(f, anchor=text)
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule)), files

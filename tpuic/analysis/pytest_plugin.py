@@ -52,3 +52,13 @@ def device_gets():
     """Count jax.device_get calls over the test (no assertion)."""
     with runtime.count_device_gets() as c:
         yield c
+
+
+@pytest.fixture
+def lock_order_watch():
+    """Record the actual lock-acquisition order over the test (locks
+    *created inside* the test are watched); fails the test if an
+    observed edge closes a cycle.  Cross-check against the static graph
+    with ``watch.check(runtime.static_lock_edges([...]))``."""
+    with runtime.lock_order_watch() as w:
+        yield w

@@ -116,6 +116,42 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "Imported name never referenced in the module."),
     Rule("TPU502", "dead-code", Severity.WARNING,
          "Statement unreachable after return/raise/break/continue."),
+    # -- project-wide passes (analysis/conc.py, spmd.py, contracts.py;
+    # these never fire from the per-file lint pass) -------------------
+    Rule("CONC101", "lock-order-cycle", Severity.ERROR,
+         "Cycle in the project-wide lock-order graph: two threads "
+         "taking the cycle's locks in opposite orders deadlock. "
+         "Project-level finding, fingerprinted on the sorted edge set."),
+    Rule("CONC102", "signal-unsafe-call", Severity.ERROR,
+         "Lock acquisition, event-bus publish, or shared file-handle "
+         "mutation reachable from a signal-handler registration — the "
+         "handler may interrupt the frame that holds the resource "
+         "(the PR-8 FlightRecorder deadlock, codified)."),
+    Rule("CONC103", "unlocked-shared-closure", Severity.WARNING,
+         "threading.Thread target closes over a variable both the "
+         "thread and the spawning scope mutate with no common lock."),
+    Rule("SPMD101", "rank-divergent-collective", Severity.ERROR,
+         "Collective (psum/pmean/all_gather/ppermute/all_to_all/...) "
+         "reachable under control flow conditioned on a rank-dependent "
+         "value (process_index, TPUIC_FLEET_RANK, rank attrs) — ranks "
+         "that skip it hang the fleet at the next sync point."),
+    Rule("SPMD102", "collective-order-divergence", Severity.WARNING,
+         "Two functions execute the same pair of collectives in "
+         "opposite orders — opposite sync-point acquisition orders "
+         "across ranks, the collective flavor of CONC101."),
+    Rule("CTR101", "event-kind-contract", Severity.ERROR,
+         "Every published event kind must be registered in EVENT_KINDS "
+         "and every registered kind must have a schema row in "
+         "docs/observability.md."),
+    Rule("CTR102", "prom-row-contract", Severity.WARNING,
+         "Every metric row name emitted by telemetry/prom.py must "
+         "appear in docs/observability.md (and stay statically "
+         "enumerable so this check can see it)."),
+    Rule("CTR103", "exit-code-contract", Severity.ERROR,
+         "Supervisor EXIT_* constants must be distinct, never shadowed "
+         "in gang.py, never bypassed with raw sys.exit(<int>) "
+         "literals, and documented (value + name) in "
+         "docs/robustness.md."),
 )}
 
 

@@ -15,6 +15,13 @@ test_faults / test_telemetry used to each reinvent (docs/analysis.md):
   transfer counting (the deferred-drain discipline: one batched get per
   log interval, nothing per step).
 - ``no_tracer_leaks()``: ``jax.check_tracer_leaks`` over a block.
+- ``LockOrderWatch``: the dynamic half of the CONC101 lock-order rule —
+  patches the ``threading.Lock``/``RLock`` factories for a block,
+  records the *actual* acquisition-order edges per thread, and
+  ``check()`` cross-checks them against the static lock graph: an
+  observed edge that closes a cycle is a hard failure (a real deadlock
+  the static pass under-approximated), a static edge never exercised is
+  a stale warning (the graph — or the test — has drifted).
 
 Every checker is host-side arithmetic over events jax already emits:
 enabling them adds zero device syncs and zero compiles (asserted by
@@ -28,8 +35,13 @@ linter) stays importable and fast in environments without jax.
 from __future__ import annotations
 
 import contextlib
+import linecache
+import os
+import re
+import sys
 import threading
-from typing import Dict, Iterator, Optional
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence,
+                    Set, Tuple)
 
 # jax.monitoring key suffixes (jax 0.4.x): one trio per compilation —
 # jaxpr_trace / jaxpr_to_mlir_module / backend_compile.  Retraces that
@@ -211,3 +223,209 @@ def no_tracer_leaks() -> Iterator[None]:
 
     with jax.check_tracer_leaks():
         yield
+
+
+# -- lock-order watch ----------------------------------------------------
+
+class LockOrderViolation(AssertionError):
+    """An observed acquisition edge closed a cycle: two threads really
+    did take the same locks in opposite orders inside the watched block
+    — the deadlock the static CONC101 pass exists to prevent."""
+
+
+_LOCK_NAME_RE = re.compile(r"(?:self\.)?(\w+)\s*(?::[^=]+)?=\s*threading")
+
+
+class _WatchedLock:
+    """Thin shim over a real Lock/RLock that reports acquisitions and
+    releases to its :class:`LockOrderWatch`.  Everything else (including
+    the ``_release_save``/``_acquire_restore``/``_is_owned`` trio
+    ``threading.Condition`` borrows from RLocks) delegates to the real
+    lock via ``__getattr__`` — a Condition built over a watched lock
+    keeps working; its wait-window release is simply not tracked, which
+    only ever *under*-reports edges, never invents one."""
+
+    def __init__(self, real, name: str, watch: "LockOrderWatch") -> None:
+        self._real = real
+        self._name = name
+        self._watch = watch
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._watch._note_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._watch._note_release(self._name)
+        self._real.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._real, attr)
+
+    def __repr__(self) -> str:
+        return f"<watched {self._name} {self._real!r}>"
+
+
+def _edge_tail(key: str) -> str:
+    """'_lock' for 'tpuic.serve.engine::Engine._lock' — the attr-name
+    tail both the static keys and the runtime creation-site names end
+    with, so the two vocabularies compare."""
+    return key.rsplit("::", 1)[-1].rsplit(".", 1)[-1]
+
+
+class LockOrderWatch:
+    """Record the actual lock-acquisition order for a block.
+
+    Patches the ``threading.Lock``/``threading.RLock`` factories so
+    every lock *created inside the block* is a :class:`_WatchedLock`
+    (pre-existing locks are untouched — watch the code under test by
+    constructing it inside the block).  Each lock is named by its
+    creation site (``module::attr`` via the assignment's source text),
+    which is the same identity the static pass gives class-attribute
+    locks, so ``check()`` can cross the two graphs.
+
+    Per-thread held stacks turn every acquisition under a held lock
+    into an edge; an edge whose reverse path already exists is recorded
+    as a violation and raised by :meth:`check` (not inside ``acquire``
+    — raising mid-acquire would leave the code under test half-locked).
+    """
+
+    def __init__(self) -> None:
+        self._real_lock = threading.Lock
+        self._real_rlock = threading.RLock
+        self._mu = self._real_lock()      # guards edges/violations
+        self._held = threading.local()
+        self.edges: Dict[Tuple[str, str], str] = {}   # edge -> thread
+        self.violations: List[str] = []
+
+    # -- naming --------------------------------------------------------
+    def _name_lock(self, kind: str) -> str:
+        f = sys._getframe(2)
+        while f is not None and f.f_globals.get("__name__") == __name__:
+            f = f.f_back
+        if f is None:  # unreachable in practice; keep a stable fallback
+            return f"?::{kind}"
+        line = linecache.getline(f.f_code.co_filename, f.f_lineno)
+        m = _LOCK_NAME_RE.search(line)
+        base = m.group(1) if m else \
+            f"{kind}@{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        return f"{f.f_globals.get('__name__', '?')}::{base}"
+
+    # -- recording -----------------------------------------------------
+    def _stack(self) -> List[str]:
+        try:
+            return self._held.stack
+        except AttributeError:
+            self._held.stack = []
+            return self._held.stack
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = {src}
+        queue = [src]
+        while queue:
+            node = queue.pop()
+            for (a, b) in self.edges:
+                if a == node and b not in seen:
+                    if b == dst:
+                        return True
+                    seen.add(b)
+                    queue.append(b)
+        return False
+
+    def _note_acquire(self, name: str) -> None:
+        stack = self._stack()
+        # get_ident, not current_thread(): the latter builds a
+        # _DummyThread (whose Event would be a watched lock → infinite
+        # re-entry) when called from a thread mid-bootstrap.
+        thread = f"tid={threading.get_ident()}"
+        with self._mu:
+            for held in stack:
+                if held == name or (held, name) in self.edges:
+                    continue
+                # Reverse reachability BEFORE inserting: a path name->held
+                # plus this edge held->name is an order inversion.
+                if self._reaches(name, held):
+                    self.violations.append(
+                        f"{held} -> {name} (thread {thread}) closes a "
+                        f"cycle with the already-observed reverse path")
+                self.edges[(held, name)] = thread
+        stack.append(name)
+
+    def _note_release(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
+
+    # -- patching ------------------------------------------------------
+    def install(self) -> None:
+        watch = self
+
+        def make_lock():
+            return _WatchedLock(watch._real_lock(),
+                                watch._name_lock("Lock"), watch)
+
+        def make_rlock():
+            return _WatchedLock(watch._real_rlock(),
+                                watch._name_lock("RLock"), watch)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+
+    def uninstall(self) -> None:
+        threading.Lock = self._real_lock
+        threading.RLock = self._real_rlock
+
+    # -- the cross-check ----------------------------------------------
+    def check(self, static_edges: Iterable[Tuple[str, str]] = ()
+              ) -> List[str]:
+        """Raise :class:`LockOrderViolation` if any observed edge closed
+        a cycle; otherwise return the *stale* static edges — (A, B)
+        pairs the static graph claims but this run never exercised
+        (compared by attr-name tail, the vocabulary both sides share).
+        Stale edges are warnings, not failures: the block may simply
+        not drive that path — but a persistently stale edge means the
+        static graph or the test has drifted."""
+        if self.violations:
+            raise LockOrderViolation(
+                "lock-order inversion(s) observed at runtime:\n  " +
+                "\n  ".join(self.violations))
+        observed = {(_edge_tail(a), _edge_tail(b)) for a, b in self.edges}
+        stale: List[str] = []
+        for a, b in static_edges:
+            if (_edge_tail(a), _edge_tail(b)) not in observed:
+                stale.append(f"static edge {a} -> {b} never observed")
+        return stale
+
+
+@contextlib.contextmanager
+def lock_order_watch() -> Iterator[LockOrderWatch]:
+    """Watch lock creation + acquisition order over a block; calls
+    ``check()`` (cycle detection only — pass static edges yourself for
+    the drift half) on clean exit."""
+    w = LockOrderWatch()
+    w.install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+    w.check()
+
+
+def static_lock_edges(paths: Sequence[str]) -> Set[Tuple[str, str]]:
+    """The static CONC101 lock graph for the given files/dirs — the
+    ``check()`` input for cross-checking a runtime watch against what
+    the analyzer believes (docs/analysis.md, "Runtime cross-check")."""
+    from tpuic.analysis.callgraph import Project
+    from tpuic.analysis.conc import lock_order_edges
+    from tpuic.analysis.core import collect_files
+
+    return lock_order_edges(Project(collect_files(paths)))

@@ -1,0 +1,243 @@
+"""SPMD — collective-consistency analysis (docs/analysis.md).
+
+In SPMD code every process must execute the same collectives in the
+same order; a collective one rank skips (or reorders) hangs the fleet
+at the next synchronization point, with no traceback anywhere.
+
+- **SPMD101 rank-divergent-collective**: a collective
+  (``psum``/``pmean``/``all_gather``/``ppermute``/``all_to_all``/...)
+  reachable under Python control flow conditioned on a rank-dependent
+  value: ``jax.process_index()``, the ``TPUIC_FLEET_RANK`` env var, a
+  name/attribute whose identifier is literally ``rank`` (``ranks`` — a
+  world *size*, identical everywhere — deliberately does not taint), or
+  a call to a function that derives such a value (``is_main_process``).
+  Both forms are caught: a collective lexically inside the tainted
+  branch (or one resolved call away), and a tainted early ``return``
+  lexically above a collective later in the same function.
+- **SPMD102 collective-order-divergence**: two functions that execute
+  the same pair of distinct collectives in opposite orders — two call
+  paths through them give two ranks opposite acquisition orders on the
+  fleet's synchronization points, the collective flavor of CONC101.
+  Project-level finding, fingerprinted on the sorted pair.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpuic.analysis.callgraph import FuncInfo, Project, dotted
+from tpuic.analysis.core import Finding, Severity
+
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "all_to_all", "psum_scatter", "pshuffle", "axis_index_groups_sum",
+})
+
+# Identifier segments that mark a value as rank-dependent.  The word
+# boundary matters: 'rank'/'fleet_rank'/'rank_id' taint, 'ranks' (world
+# size) does not.
+_RANK_WORD = re.compile(r"(?:^|_)rank(?:$|_)")
+_RANK_ENV = re.compile(r"RANK", re.IGNORECASE)
+
+
+def _is_rank_name(name: str) -> bool:
+    return bool(_RANK_WORD.search(name)) or "process_index" in name
+
+
+def _rank_source_funcs(project: Project) -> Set[int]:
+    """id(FuncInfo) of functions whose body derives a rank-dependent
+    value (``jax.process_index()`` or a *_RANK env read) — a call to one
+    of these taints the expression around it."""
+    out: Set[int] = set()
+    for fi in project.funcs():
+        for call in fi.calls:
+            d = dotted(call.func)
+            if d is None:
+                continue
+            tail = d.split(".")[-1]
+            if tail == "process_index":
+                out.add(id(fi))
+            elif tail in ("getenv", "get") and call.args \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str) \
+                    and _RANK_ENV.search(call.args[0].value):
+                out.add(id(fi))
+    return out
+
+
+def _expr_rank_tainted(project: Project, fi: FuncInfo, expr: ast.AST,
+                       rank_funcs: Set[int]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and _is_rank_name(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _is_rank_name(n.attr):
+            return True
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            tail = (d or "").split(".")[-1]
+            if tail == "process_index":
+                return True
+            if tail in ("getenv", "get") and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str) \
+                    and _RANK_ENV.search(n.args[0].value):
+                return True
+            for callee in project.resolve_call(fi, n):
+                if id(callee) in rank_funcs:
+                    return True
+    return False
+
+
+def _collective_id(call: ast.Call) -> Optional[str]:
+    """'psum' / 'ppermute@x' (axis_name folded in when constant)."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    tail = d.split(".")[-1]
+    if tail not in COLLECTIVES:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name" and isinstance(kw.value, ast.Constant):
+            return f"{tail}@{kw.value.value}"
+    return tail
+
+
+def _own_nodes(node: ast.AST) -> List[ast.AST]:
+    out: List[ast.AST] = []
+
+    def rec(n: ast.AST) -> None:
+        out.append(n)
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            rec(c)
+    rec(node)
+    return out
+
+
+def _direct_collectives(fi: FuncInfo) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for stmt in fi.node.body:
+        for n in _own_nodes(stmt):
+            if isinstance(n, ast.Call):
+                cid = _collective_id(n)
+                if cid is not None:
+                    out.append((cid, n.lineno))
+    return out
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def run_spmd(project: Project) -> List[Finding]:
+    rank_funcs = _rank_source_funcs(project)
+    # One resolved call level: functions with direct collectives, so a
+    # rank-gated call to `ring_step()` is as divergent as a rank-gated
+    # psum.
+    has_direct: Dict[int, List[Tuple[str, int]]] = {
+        id(f): _direct_collectives(f) for f in project.funcs()}
+    findings: List[Finding] = []
+
+    for fi in project.funcs():
+        if fi.allowlisted("SPMD101"):
+            continue
+        mod = fi.module
+        directs = has_direct[id(fi)]
+        for stmt in fi.node.body:
+            for n in _own_nodes(stmt):
+                if not isinstance(n, (ast.If, ast.While)):
+                    continue
+                if not _expr_rank_tainted(project, fi, n.test,
+                                          rank_funcs):
+                    continue
+                # Form 1: collective inside the tainted branch (or one
+                # resolved call away).
+                branch_nodes: List[ast.AST] = []
+                for sub in n.body + n.orelse:
+                    branch_nodes.extend(_own_nodes(sub))
+                hit = False
+                for b in branch_nodes:
+                    if not isinstance(b, ast.Call):
+                        continue
+                    cid = _collective_id(b)
+                    if cid is not None:
+                        findings.append(Finding(
+                            "SPMD101", Severity.ERROR, mod.path,
+                            b.lineno,
+                            f"collective '{cid}' under rank-dependent "
+                            f"control flow (condition at line "
+                            f"{n.lineno}) — ranks that skip it hang "
+                            f"the fleet at the next sync point"))
+                        hit = True
+                        continue
+                    for callee in project.resolve_call(fi, b):
+                        inner = has_direct.get(id(callee), [])
+                        if inner:
+                            findings.append(Finding(
+                                "SPMD101", Severity.ERROR, mod.path,
+                                b.lineno,
+                                f"call to {callee.qualname}() "
+                                f"(contains collective "
+                                f"'{inner[0][0]}') under "
+                                f"rank-dependent control flow "
+                                f"(condition at line {n.lineno})"))
+                            hit = True
+                            break
+                if hit:
+                    continue
+                # Form 2: tainted early exit above a later collective.
+                if isinstance(n, ast.If) and _terminates(n.body) \
+                        and not n.orelse:
+                    end = getattr(n, "end_lineno", n.lineno) or n.lineno
+                    later = [(cid, ln) for cid, ln in directs
+                             if ln > end]
+                    if later:
+                        cid, ln = later[0]
+                        findings.append(Finding(
+                            "SPMD101", Severity.ERROR, mod.path,
+                            n.lineno,
+                            f"rank-dependent early exit above "
+                            f"collective '{cid}' (line {ln}) — "
+                            f"exiting ranks never reach it; the rest "
+                            f"hang"))
+
+    # SPMD102: opposite-order collective pairs across functions.
+    seqs: List[Tuple[FuncInfo, List[Tuple[str, int]]]] = []
+    for fi in project.funcs():
+        if fi.allowlisted("SPMD102"):
+            continue
+        seq = has_direct[id(fi)]
+        if len({c for c, _ in seq}) >= 2:
+            seqs.append((fi, seq))
+    reported: Set[Tuple[str, str]] = set()
+    for i, (fa, sa) in enumerate(seqs):
+        for fb, sb in seqs[i + 1:]:
+            for a_idx, (ca, la) in enumerate(sa):
+                for cb, lb in sa[a_idx + 1:]:
+                    if ca == cb:
+                        continue
+                    # fa runs ca before cb; does fb run cb before ca?
+                    pos_b = {c: k for k, (c, _) in
+                             reversed(list(enumerate(sb)))}
+                    if cb in pos_b and ca in pos_b \
+                            and pos_b[cb] < pos_b[ca]:
+                        pair = tuple(sorted((ca, cb)))
+                        if pair in reported:
+                            continue
+                        reported.add(pair)
+                        findings.append(Finding(
+                            "SPMD102", Severity.WARNING,
+                            fa.module.path, la,
+                            f"collectives '{ca}' and '{cb}' run in "
+                            f"opposite orders: {fa.qualname}() (line "
+                            f"{la}) vs {fb.qualname}() "
+                            f"({fb.module.path}:{lb}) — two ranks on "
+                            f"the two paths deadlock at the sync "
+                            f"point",
+                            fkey=f"spmd102:{pair[0]}|{pair[1]}"))
+    return findings
